@@ -1,0 +1,340 @@
+"""Process/context basics: init, ranks, mesh, topology installation.
+
+TPU-native sibling of the reference's ``bluefog/common/basics.py`` +
+``bluefog/common/operations.cc`` init path [U] (SURVEY.md §3.1).  Where the
+reference's ``bf.init()`` boots MPI, spawns the background communication
+thread and builds MPI graph communicators, ours builds a
+``jax.sharding.Mesh`` over the TPU slice and compiles topologies into cached
+``ppermute`` plans — there is no background thread because under SPMD the
+program order *is* the coordination protocol (SURVEY.md §7 design stance).
+
+Rank model: one rank per device (the reference's one rank per GPU).  Eager
+API arrays are **rank-major**: leading axis = rank, sharded over the mesh.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import networkx as nx
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bluefog_tpu import topology_util
+from bluefog_tpu.common.config import Config
+from bluefog_tpu.common.logging_util import logger
+from bluefog_tpu.core.plan import CommPlan, compile_plan
+
+__all__ = [
+    "NODES_AXIS",
+    "MACHINES_AXIS",
+    "LOCAL_AXIS",
+    "BlueFogContext",
+    "init",
+    "shutdown",
+    "is_initialized",
+    "context",
+    "size",
+    "rank",
+    "local_size",
+    "local_rank",
+    "machine_size",
+    "machine_rank",
+    "mesh",
+    "hierarchical_mesh",
+    "set_topology",
+    "load_topology",
+    "set_machine_topology",
+    "load_machine_topology",
+    "in_neighbor_ranks",
+    "out_neighbor_ranks",
+    "in_neighbor_machine_ranks",
+    "out_neighbor_machine_ranks",
+    "is_topo_weighted",
+    "is_machine_topo_weighted",
+    "unified_mpi_window_model_supported",
+    "rank_major_sharding",
+    "replicated_sharding",
+]
+
+# Mesh axis names.  A single flat axis for rank-level gossip; a factored
+# (machines, local) view of the same devices for hierarchical ops.
+NODES_AXIS = "bf_nodes"
+MACHINES_AXIS = "bf_machines"
+LOCAL_AXIS = "bf_local"
+
+
+def _topo_key(topo: nx.DiGraph) -> Tuple:
+    return (
+        topo.number_of_nodes(),
+        tuple(sorted((int(u), int(v), round(float(d.get("weight", 1.0)), 12))
+                     for u, v, d in topo.edges(data=True))),
+    )
+
+
+class BlueFogContext:
+    """Global framework state (the reference's ``BluefogGlobalState``
+    singleton, ``bluefog/common/global_state.h`` [U], minus the thread)."""
+
+    def __init__(
+        self,
+        devices: Optional[Sequence[jax.Device]] = None,
+        local_size: Optional[int] = None,
+        topology: Optional[nx.DiGraph] = None,
+    ):
+        self.config = Config.from_env()
+        devs = list(devices) if devices is not None else jax.devices()
+        self.devices = devs
+        self.size = len(devs)
+        self.local_size_ = local_size or jax.local_device_count()
+        if self.size % self.local_size_ != 0:
+            raise ValueError(
+                f"size {self.size} not divisible by local_size {self.local_size_}"
+            )
+        self.machine_size_ = self.size // self.local_size_
+        dev_array = np.array(devs)
+        self.mesh = Mesh(dev_array, (NODES_AXIS,))
+        self.hier_mesh = Mesh(
+            dev_array.reshape(self.machine_size_, self.local_size_),
+            (MACHINES_AXIS, LOCAL_AXIS),
+        )
+        self._plan_cache: Dict[Tuple, CommPlan] = {}
+        self._jit_cache: Dict[Tuple, object] = {}
+        self._lock = threading.Lock()
+        self.topology: Optional[nx.DiGraph] = None
+        self.machine_topology: Optional[nx.DiGraph] = None
+        self.windows: Dict[str, object] = {}  # name -> windows._Window
+        self.win_associated_p_enabled = False
+        self.set_topology(
+            topology
+            if topology is not None
+            else topology_util.ExponentialTwoGraph(self.size)
+        )
+        if self.machine_size_ > 1:
+            self.set_machine_topology(
+                topology_util.ExponentialTwoGraph(self.machine_size_)
+            )
+
+    # -- topology ---------------------------------------------------------
+
+    def set_topology(self, topo: nx.DiGraph) -> bool:
+        if topo.number_of_nodes() != self.size:
+            raise ValueError(
+                f"topology has {topo.number_of_nodes()} nodes, world size is {self.size}"
+            )
+        if self.topology is not None and topology_util.IsTopologyEquivalent(
+            topo, self.topology
+        ):
+            logger.debug("set_topology: identical topology, skipping")
+            return False
+        self.topology = topo
+        self.plan  # eagerly compile + cache
+        return True
+
+    def set_machine_topology(self, topo: nx.DiGraph) -> bool:
+        if topo.number_of_nodes() != self.machine_size_:
+            raise ValueError(
+                f"machine topology has {topo.number_of_nodes()} nodes, "
+                f"machine size is {self.machine_size_}"
+            )
+        self.machine_topology = topo
+        self.machine_plan
+        return True
+
+    def plan_for(self, topo: nx.DiGraph, **overrides) -> CommPlan:
+        key = (_topo_key(topo), tuple(sorted(overrides.items())))
+        with self._lock:
+            if key not in self._plan_cache:
+                self._plan_cache[key] = compile_plan(topo, **overrides)
+            return self._plan_cache[key]
+
+    def jit_cache(self, key, builder):
+        """Compiled-callable cache shared by the eager op veneers."""
+        with self._lock:
+            fn = self._jit_cache.get(key)
+            if fn is None:
+                fn = self._jit_cache[key] = builder()
+            return fn
+
+    @property
+    def plan(self) -> CommPlan:
+        return self.plan_for(self.topology)
+
+    @property
+    def machine_plan(self) -> CommPlan:
+        return self.plan_for(self.machine_topology)
+
+
+_context: Optional[BlueFogContext] = None
+
+
+def init(
+    topology: Optional[nx.DiGraph] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    local_size: Optional[int] = None,
+) -> None:
+    """Initialize bluefog_tpu (reference ``bf.init()`` — SURVEY.md §3.1).
+
+    In a multi-host TPU pod, call ``jax.distributed.initialize()`` first (or
+    launch via ``bftpu-run``, which does); ``init`` then builds the global
+    mesh over all devices.  Default topology: ``ExponentialTwoGraph(size)``
+    (the reference's default).
+
+    ``local_size`` overrides devices-per-machine for hierarchical ops; by
+    default it is ``jax.local_device_count()``.
+    """
+    global _context
+    _context = BlueFogContext(devices=devices, local_size=local_size, topology=topology)
+
+
+def shutdown() -> None:
+    """Reference ``bf.shutdown()``; releases the context."""
+    global _context
+    _context = None
+
+
+def is_initialized() -> bool:
+    return _context is not None
+
+
+def context() -> BlueFogContext:
+    if _context is None:
+        raise RuntimeError("bluefog_tpu is not initialized; call bluefog_tpu.init()")
+    return _context
+
+
+def size() -> int:
+    """World size = number of devices (ranks) in the mesh."""
+    return context().size
+
+
+def rank() -> int:
+    """Global rank of this controller's first addressable device.
+
+    Under single-controller JAX one process drives every rank, so eager ops
+    act on all ranks at once (rank-major arrays); this exists for launch
+    scripts and logging parity with the reference's per-process rank.
+    """
+    ctx = context()
+    first = min(
+        (i for i, d in enumerate(ctx.devices) if d.process_index == jax.process_index()),
+        default=0,
+    )
+    return first
+
+
+def local_size() -> int:
+    return context().local_size_
+
+
+def local_rank() -> int:
+    return rank() % context().local_size_
+
+
+def machine_size() -> int:
+    return context().machine_size_
+
+
+def machine_rank() -> int:
+    return rank() // context().local_size_
+
+
+def mesh() -> Mesh:
+    """The flat 1-D ``(bf_nodes,)`` mesh over all ranks."""
+    return context().mesh
+
+
+def hierarchical_mesh() -> Mesh:
+    """The same devices viewed as ``(bf_machines, bf_local)``."""
+    return context().hier_mesh
+
+
+def set_topology(topology: Optional[nx.DiGraph] = None) -> bool:
+    """Install the virtual topology (reference ``bf.set_topology`` [U]).
+    Defaults to ``ExponentialTwoGraph(size)``.  Returns True if changed."""
+    ctx = context()
+    if topology is None:
+        topology = topology_util.ExponentialTwoGraph(ctx.size)
+    return ctx.set_topology(topology)
+
+
+def load_topology() -> nx.DiGraph:
+    """Return the installed topology (reference ``bf.load_topology`` [U])."""
+    return context().topology
+
+
+def set_machine_topology(topology: nx.DiGraph) -> bool:
+    """Install the machine-level topology used by
+    ``hierarchical_neighbor_allreduce`` (reference
+    ``bf.set_machine_topology`` [U])."""
+    return context().set_machine_topology(topology)
+
+
+def load_machine_topology() -> nx.DiGraph:
+    return context().machine_topology
+
+
+def in_neighbor_ranks(rank_: Optional[int] = None) -> List[int]:
+    """In-neighbors of ``rank_`` (default: this process's rank) under the
+    installed topology (reference ``bf.in_neighbor_ranks`` [U])."""
+    r = rank() if rank_ is None else rank_
+    return list(context().plan.in_neighbors[r])
+
+
+def out_neighbor_ranks(rank_: Optional[int] = None) -> List[int]:
+    r = rank() if rank_ is None else rank_
+    return list(context().plan.out_neighbors[r])
+
+
+def in_neighbor_machine_ranks(machine_rank_: Optional[int] = None) -> List[int]:
+    ctx = context()
+    if ctx.machine_topology is None:
+        return []
+    r = machine_rank() if machine_rank_ is None else machine_rank_
+    return list(ctx.machine_plan.in_neighbors[r])
+
+
+def out_neighbor_machine_ranks(machine_rank_: Optional[int] = None) -> List[int]:
+    ctx = context()
+    if ctx.machine_topology is None:
+        return []
+    r = machine_rank() if machine_rank_ is None else machine_rank_
+    return list(ctx.machine_plan.out_neighbors[r])
+
+
+def is_topo_weighted() -> bool:
+    """Whether the installed topology carries explicit (non-uniform) weights
+    (reference ``bf.is_topo_weighted`` [U])."""
+    return bool(context().topology.graph.get("weighted", False))
+
+
+def is_machine_topo_weighted() -> bool:
+    topo = context().machine_topology
+    return bool(topo.graph.get("weighted", False)) if topo is not None else False
+
+
+def unified_mpi_window_model_supported() -> bool:
+    """Reference API parity (``bf.unified_mpi_window_model_supported`` [U]).
+
+    Always True here: the mailbox emulation gives every rank a uniform
+    window model by construction (no MPI implementation quirks to detect).
+    """
+    return True
+
+
+# -- sharding helpers used across the eager API ---------------------------
+
+
+def rank_major_sharding(ctx: Optional[BlueFogContext] = None) -> NamedSharding:
+    """Sharding for rank-major arrays: leading axis split over ranks."""
+    ctx = ctx or context()
+    return NamedSharding(ctx.mesh, P(NODES_AXIS))
+
+
+def replicated_sharding(ctx: Optional[BlueFogContext] = None) -> NamedSharding:
+    ctx = ctx or context()
+    return NamedSharding(ctx.mesh, P())

@@ -1,0 +1,405 @@
+"""User-facing collective ops — the eager, rank-major veneer.
+
+TPU-native sibling of the reference's ``bluefog/torch/mpi_ops.py`` [U]
+(SURVEY.md §2.2): same verbs (``allreduce``, ``broadcast``, ``allgather``,
+``neighbor_allgather``, ``neighbor_allreduce``,
+``hierarchical_neighbor_allreduce``, ``barrier``) with blocking and
+``_nonblocking`` variants, static-topology weights from the installed graph
+and dynamic per-call neighbor sets.
+
+Programming model difference, by design: the reference is one process per
+rank, so each call site passes *its own* rank's weights.  JAX is
+single-controller SPMD, so eager arrays are **rank-major** — leading axis =
+rank, sharded over the mesh — and dynamic arguments are per-rank sequences
+(index r holds what rank r would have passed upstream).  Scalars broadcast
+to all ranks.  The "nonblocking" variants return a :class:`Handle` backed by
+JAX's async dispatch — the transfer is already in flight when the call
+returns, exactly the overlap the reference's background thread provided
+(SURVEY.md §3.2 TPU mapping).
+
+For code *inside* ``jit``/``shard_map`` (the idiomatic TPU path), use
+:mod:`bluefog_tpu.ops_spmd` directly.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from bluefog_tpu import ops_spmd, topology_util
+from bluefog_tpu.core import basics
+from bluefog_tpu.core.basics import LOCAL_AXIS, MACHINES_AXIS, NODES_AXIS
+from bluefog_tpu.core.plan import CommPlan, plan_from_neighbor_lists
+from bluefog_tpu.timeline import timeline_context
+
+__all__ = [
+    "Handle",
+    "allreduce",
+    "allreduce_nonblocking",
+    "broadcast",
+    "broadcast_nonblocking",
+    "allgather",
+    "allgather_nonblocking",
+    "neighbor_allgather",
+    "neighbor_allgather_nonblocking",
+    "neighbor_allreduce",
+    "neighbor_allreduce_nonblocking",
+    "hierarchical_neighbor_allreduce",
+    "hierarchical_neighbor_allreduce_nonblocking",
+    "barrier",
+    "poll",
+    "synchronize",
+    "wait",
+]
+
+
+class Handle:
+    """Nonblocking-op result (the reference's integer handle +
+    ``HandleManager``, ``bluefog/torch/handle_manager.h`` [U]).
+
+    JAX dispatch is asynchronous: by the time a Handle exists the collective
+    is already enqueued on device.  ``poll`` asks the runtime whether the
+    output buffers are materialized; ``wait`` blocks and returns the value.
+    """
+
+    __slots__ = ("_value",)
+
+    def __init__(self, value):
+        self._value = value
+
+    def poll(self) -> bool:
+        leaves = jax.tree_util.tree_leaves(self._value)
+        return all(
+            leaf.is_ready() if hasattr(leaf, "is_ready") else True for leaf in leaves
+        )
+
+    def wait(self):
+        return jax.block_until_ready(self._value)
+
+
+def poll(handle: Handle) -> bool:
+    """Reference ``bf.poll(handle)`` [U]."""
+    return handle.poll()
+
+
+def synchronize(handle: Handle):
+    """Reference ``bf.synchronize(handle)`` [U] — block and return output."""
+    return handle.wait()
+
+
+wait = synchronize
+
+
+def _ctx():
+    return basics.context()
+
+
+def _jit_cached(key, builder):
+    return _ctx().jit_cache(key, builder)
+
+
+def _rank_major(fn, *, out_specs=P(NODES_AXIS)):
+    mesh = _ctx().mesh
+    return jax.jit(
+        jax.shard_map(fn, mesh=mesh, in_specs=P(NODES_AXIS), out_specs=out_specs)
+    )
+
+
+def _as_tree(x):
+    return jax.tree_util.tree_map(jnp.asarray, x)
+
+
+# --------------------------------------------------------------------------
+# Global collectives
+# --------------------------------------------------------------------------
+
+
+def allreduce(x, average: bool = True, name: Optional[str] = None):
+    """Global average (default) or sum across all ranks; rank-major in/out
+    (reference ``bf.allreduce(tensor, average=True)`` [U])."""
+    del name
+    with timeline_context("allreduce"):
+        f = _jit_cached(
+            ("allreduce", bool(average)),
+            lambda: _rank_major(
+                functools.partial(ops_spmd.allreduce, axis_name=NODES_AXIS, average=average)
+            ),
+        )
+        return f(_as_tree(x))
+
+
+def allreduce_nonblocking(x, average: bool = True, name: Optional[str] = None) -> Handle:
+    return Handle(allreduce(x, average=average, name=name))
+
+
+def broadcast(x, root_rank: int = 0, name: Optional[str] = None):
+    """All ranks receive ``root_rank``'s slice (reference ``bf.broadcast`` [U])."""
+    del name
+    with timeline_context("broadcast"):
+        f = _jit_cached(
+            ("broadcast", int(root_rank)),
+            lambda: _rank_major(
+                functools.partial(
+                    ops_spmd.broadcast, root_rank=int(root_rank), axis_name=NODES_AXIS
+                )
+            ),
+        )
+        return f(_as_tree(x))
+
+
+def broadcast_nonblocking(x, root_rank: int = 0, name: Optional[str] = None) -> Handle:
+    return Handle(broadcast(x, root_rank=root_rank, name=name))
+
+
+def allgather(x, name: Optional[str] = None):
+    """Every rank receives the concatenation (along the per-rank axis 0) of
+    all ranks' tensors: rank-major input ``[size, n0, ...]`` -> output
+    ``[size, size*n0, ...]`` (reference ``bf.allgather`` [U])."""
+    del name
+    with timeline_context("allgather"):
+
+        def spmd(t):
+            def per_leaf(a):
+                g = jax.lax.all_gather(a, NODES_AXIS, axis=0, tiled=True)
+                return g[None]  # leading rank axis for rank-major out_specs
+
+            return jax.tree_util.tree_map(per_leaf, t)
+
+        f = _jit_cached(("allgather",), lambda: _rank_major(spmd))
+        out = f(_as_tree(x))
+        return jax.tree_util.tree_map(
+            lambda a: a.reshape((a.shape[0], a.shape[1] * a.shape[2]) + a.shape[3:]),
+            out,
+        )
+
+
+def allgather_nonblocking(x, name: Optional[str] = None) -> Handle:
+    return Handle(allgather(x, name=name))
+
+
+def barrier():
+    """Block until all in-flight device work is complete (reference
+    ``bf.barrier`` [U]).  Executes a trivial psum over the mesh and waits."""
+    f = _jit_cached(
+        ("barrier",),
+        lambda: _rank_major(
+            functools.partial(ops_spmd.allreduce, axis_name=NODES_AXIS, average=False)
+        ),
+    )
+    jax.block_until_ready(f(jnp.zeros((_ctx().size, 1))))
+
+
+# --------------------------------------------------------------------------
+# Neighbor collectives (static + dynamic topology)
+# --------------------------------------------------------------------------
+
+WeightsArg = Union[None, Sequence[Dict[int, float]]]
+
+
+def _dynamic_plan(
+    size: int,
+    self_weight,
+    src_weights: WeightsArg,
+    dst_weights: WeightsArg,
+) -> CommPlan:
+    """Translate the reference's dynamic-topology arguments into a CommPlan.
+
+    Effective weight of edge s->d: ``src_weights[d][s] * dst_weights[s][d]``
+    (receiver-side weight times sender-side scale — the reference applies
+    dst scaling at the sender and src weighting at the receiver, SURVEY.md
+    §3.2/§2.2 [U]); either side defaults to 1 when not given.
+    """
+    if src_weights is None and dst_weights is None:
+        raise ValueError("dynamic path needs src_weights and/or dst_weights")
+    src_lists = [[] for _ in range(size)]
+    if src_weights is not None:
+        if len(src_weights) != size:
+            raise ValueError(
+                f"src_weights must be a length-{size} sequence (one dict per rank)"
+            )
+        for d in range(size):
+            src_lists[d] = sorted(int(s) for s in src_weights[d])
+    if dst_weights is not None:
+        if len(dst_weights) != size:
+            raise ValueError(
+                f"dst_weights must be a length-{size} sequence (one dict per rank)"
+            )
+        inferred = topology_util.InferSourceFromDestinationRanks(
+            [sorted(int(d) for d in dst_weights[s]) for s in range(size)]
+        )
+        if src_weights is None:
+            src_lists = inferred
+        elif [sorted(x) for x in src_lists] != [sorted(x) for x in inferred]:
+            raise ValueError(
+                "src_weights and dst_weights describe different edge sets"
+            )
+    eff = []
+    for d in range(size):
+        wd = {}
+        for s in src_lists[d]:
+            w = 1.0
+            if src_weights is not None:
+                w *= float(src_weights[d][s])
+            if dst_weights is not None:
+                w *= float(dst_weights[s][d])
+            wd[s] = w
+        eff.append(wd)
+    if self_weight is None:
+        self_w = [1.0 - sum(eff[d].values()) for d in range(size)]
+    elif np.isscalar(self_weight):
+        self_w = [float(self_weight)] * size
+    else:
+        self_w = [float(w) for w in self_weight]
+        if len(self_w) != size:
+            raise ValueError(f"self_weight must be scalar or length-{size}")
+    return plan_from_neighbor_lists(size, src_lists, src_weights=eff, self_weights=self_w)
+
+
+def neighbor_allreduce(
+    x,
+    self_weight=None,
+    src_weights: WeightsArg = None,
+    dst_weights: WeightsArg = None,
+    name: Optional[str] = None,
+):
+    """Weighted neighbor averaging — the reference's hot path
+    (``bf.neighbor_allreduce``, SURVEY.md §3.2 [U]).
+
+    Static mode (no weight args): weights come from the installed topology
+    (``set_topology``), self weight = 1 - sum(in-weights).
+
+    Dynamic mode: per-rank ``src_weights``/``dst_weights`` sequences of
+    ``{rank: weight}`` dicts define this call's edge set (the reference's
+    per-call dynamic topology).  ``self_weight`` may be a scalar (all ranks)
+    or per-rank sequence; default keeps row-stochasticity.
+    """
+    del name
+    ctx = _ctx()
+    with timeline_context("neighbor_allreduce"):
+        if src_weights is None and dst_weights is None and self_weight is None:
+            plan = ctx.plan
+        elif src_weights is None and dst_weights is None:
+            sw = (
+                float(self_weight)
+                if np.isscalar(self_weight)
+                else tuple(float(w) for w in self_weight)
+            )
+            plan = ctx.plan_for(ctx.topology, self_weight=sw)
+        else:
+            plan = _dynamic_plan(ctx.size, self_weight, src_weights, dst_weights)
+        f = _jit_cached(
+            ("neighbor_allreduce", plan),
+            lambda: _rank_major(
+                functools.partial(
+                    ops_spmd.neighbor_allreduce, plan=plan, axis_name=NODES_AXIS
+                )
+            ),
+        )
+        return f(_as_tree(x))
+
+
+def neighbor_allreduce_nonblocking(
+    x,
+    self_weight=None,
+    src_weights: WeightsArg = None,
+    dst_weights: WeightsArg = None,
+    name: Optional[str] = None,
+) -> Handle:
+    return Handle(
+        neighbor_allreduce(
+            x,
+            self_weight=self_weight,
+            src_weights=src_weights,
+            dst_weights=dst_weights,
+            name=name,
+        )
+    )
+
+
+def neighbor_allgather(x, name: Optional[str] = None):
+    """Concatenate in-neighbor tensors (ascending source rank) per rank:
+    rank-major ``[size, n0, ...]`` -> ``[size, D*n0, ...]`` for in-degree-D
+    regular topologies (reference ``bf.neighbor_allgather`` [U]).
+
+    Irregular topologies return ``[size, maxD, n0, ...]`` zero-padded
+    (static SPMD shapes cannot be ragged); valid counts are
+    ``context().plan.in_degrees``.
+    """
+    del name
+    ctx = _ctx()
+    plan = ctx.plan
+    with timeline_context("neighbor_allgather"):
+
+        def spmd(t):
+            y = ops_spmd.neighbor_allgather(t, plan=plan, axis_name=NODES_AXIS)
+            return jax.tree_util.tree_map(lambda a: jnp.moveaxis(a, 1, 0), y)
+
+        f = _jit_cached(("neighbor_allgather", plan), lambda: _rank_major(spmd))
+        out = f(_as_tree(x))
+        if plan.is_regular:
+            return jax.tree_util.tree_map(
+                lambda a: a.reshape((a.shape[0], a.shape[1] * a.shape[2]) + a.shape[3:]),
+                out,
+            )
+        return out
+
+
+def neighbor_allgather_nonblocking(x, name: Optional[str] = None) -> Handle:
+    return Handle(neighbor_allgather(x, name=name))
+
+
+def hierarchical_neighbor_allreduce(
+    x,
+    self_weight: Optional[float] = None,
+    name: Optional[str] = None,
+):
+    """Intra-machine average -> machine-level gossip on the machine topology
+    -> implicit local broadcast (reference
+    ``bf.hierarchical_neighbor_allreduce`` [U]).  Rank-major in/out; all
+    ranks of a machine end with identical values.
+    """
+    del name
+    ctx = _ctx()
+    if ctx.machine_topology is None:
+        raise RuntimeError(
+            "no machine topology; call set_machine_topology() (machine_size="
+            f"{ctx.machine_size_})"
+        )
+    mplan = ctx.machine_plan
+    with timeline_context("hierarchical_neighbor_allreduce"):
+
+        def build():
+            def spmd(t):
+                return ops_spmd.hierarchical_neighbor_allreduce(
+                    t,
+                    machine_plan=mplan,
+                    machines_axis=MACHINES_AXIS,
+                    local_axis=LOCAL_AXIS,
+                    self_weight=self_weight,
+                )
+
+            mesh = ctx.hier_mesh
+            return jax.jit(
+                jax.shard_map(
+                    spmd,
+                    mesh=mesh,
+                    in_specs=P((MACHINES_AXIS, LOCAL_AXIS)),
+                    out_specs=P((MACHINES_AXIS, LOCAL_AXIS)),
+                )
+            )
+
+        f = _jit_cached(
+            ("hierarchical_neighbor_allreduce", mplan, self_weight), build
+        )
+        return f(_as_tree(x))
+
+
+def hierarchical_neighbor_allreduce_nonblocking(
+    x, self_weight: Optional[float] = None, name: Optional[str] = None
+) -> Handle:
+    return Handle(hierarchical_neighbor_allreduce(x, self_weight=self_weight, name=name))

@@ -1,0 +1,196 @@
+"""SPMD collective primitives — the compute core, used inside ``shard_map``.
+
+TPU-native sibling of the reference's controller execution layer
+(``MPIController::NeighborAllreduce`` / ``NCCLController::NeighborAllreduce``
+in ``bluefog/common/{mpi,nccl}_controller.cc`` [U], SURVEY.md §3.2): where the
+reference drains a queue on a background thread, negotiates order and issues
+``MPI_Neighbor_allgather``/grouped ``ncclSend/Recv`` plus a local weighted
+combine, here each op is a pure traced function — one ``lax.ppermute`` per
+shift class of the compiled :class:`~bluefog_tpu.core.plan.CommPlan`, fused
+by XLA with the weighted FMA combine, latency-hidden by XLA's async
+collective scheduling.
+
+Every function takes the mesh axis name(s) explicitly and works on arbitrary
+pytrees.  They are usable directly inside user ``jit``/``shard_map`` code
+(the idiomatic TPU path) and are wrapped by :mod:`bluefog_tpu.ops` for the
+eager rank-major veneer.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bluefog_tpu.core.plan import CommPlan, PermClass
+
+__all__ = [
+    "allreduce",
+    "broadcast",
+    "allgather",
+    "neighbor_allreduce",
+    "neighbor_allgather",
+    "hierarchical_neighbor_allreduce",
+    "pairwise_gossip",
+]
+
+
+def _weight_dtype(x: jnp.ndarray) -> jnp.dtype:
+    return x.dtype if jnp.issubdtype(x.dtype, jnp.inexact) else jnp.float32
+
+
+def allreduce(x, axis_name: str, *, average: bool = True):
+    """Global (p)sum/(p)mean over ``axis_name`` (reference ``bf.allreduce``,
+    default average=True [U])."""
+    op = lax.pmean if average else lax.psum
+    return jax.tree_util.tree_map(lambda a: op(a, axis_name), x)
+
+
+def broadcast(x, root_rank: int, axis_name: str):
+    """Every rank gets ``root_rank``'s value (reference ``bf.broadcast`` [U]).
+
+    Lowered as a masked psum — the XLA-native broadcast over a mesh axis.
+    """
+
+    def bcast(a):
+        idx = lax.axis_index(axis_name)
+        wdt = _weight_dtype(a)
+        masked = jnp.where(idx == root_rank, a, jnp.zeros_like(a)).astype(wdt)
+        return lax.psum(masked, axis_name).astype(a.dtype)
+
+    return jax.tree_util.tree_map(bcast, x)
+
+
+def allgather(x, axis_name: str):
+    """Concatenate every rank's tensor along a new leading axis
+    (reference ``bf.allgather`` concatenates along axis 0 [U]; reshape the
+    leading two axes to recover exactly that layout)."""
+    return jax.tree_util.tree_map(
+        lambda a: lax.all_gather(a, axis_name, axis=0, tiled=False), x
+    )
+
+
+def _class_arrays(cls: PermClass, wdt):
+    rw = jnp.asarray(cls.recv_weights, dtype=wdt)
+    return rw
+
+
+def neighbor_allreduce(
+    x,
+    plan: CommPlan,
+    axis_name: str,
+    *,
+    self_weight: Optional[float] = None,
+    average_dtype=None,
+):
+    """Weighted neighbor averaging: ``out_d = w_dd * x_d + sum_{s in N_in(d)}
+    w_ds * x_s`` — the reference's hot path (SURVEY.md §3.2).
+
+    One ``ppermute`` per shift class; the per-rank weights ride as trace-time
+    constant vectors indexed by ``axis_index`` so a single compiled program
+    serves every rank (SPMD).  ``self_weight`` overrides the plan's per-rank
+    self weights uniformly.
+    """
+
+    def nar(a):
+        wdt = average_dtype or _weight_dtype(a)
+        idx = lax.axis_index(axis_name)
+        if self_weight is None:
+            sw = jnp.asarray(plan.self_weights, dtype=wdt)[idx]
+        else:
+            sw = jnp.asarray(self_weight, dtype=wdt)
+        acc = a.astype(wdt) * sw
+        for cls in plan.classes:
+            recvd = lax.ppermute(a.astype(wdt), axis_name, cls.perm)
+            w = jnp.asarray(cls.recv_weights, dtype=wdt)[idx]
+            acc = acc + w * recvd
+        return acc
+
+    return jax.tree_util.tree_map(nar, x)
+
+
+def neighbor_allgather(x, plan: CommPlan, axis_name: str):
+    """Gather in-neighbor tensors, stacked on a new leading axis ordered by
+    ascending source rank (reference ``bf.neighbor_allgather`` concatenation
+    order [U]).
+
+    SPMD requires static shapes, so the output leading dim is the *max*
+    in-degree; ranks with smaller in-degree have zero-padded trailing slots
+    (plan.in_degrees gives the valid count — exact for regular topologies,
+    which all built-in constructors are).
+    """
+    maxd = plan.max_in_degree
+
+    def nag(a):
+        idx = lax.axis_index(axis_name)
+        out = jnp.zeros((maxd,) + a.shape, dtype=a.dtype)
+        for cls in plan.classes:
+            recvd = lax.ppermute(a, axis_name, cls.perm)
+            slot = jnp.asarray(cls.slot_index)[idx]
+            valid = jnp.asarray(cls.recv_mask)[idx].astype(bool)
+            updated = lax.dynamic_update_index_in_dim(
+                out, recvd, jnp.maximum(slot, 0), axis=0
+            )
+            out = jnp.where(valid, updated, out)
+        return out
+
+    return jax.tree_util.tree_map(nag, x)
+
+
+def hierarchical_neighbor_allreduce(
+    x,
+    machine_plan: CommPlan,
+    machines_axis: str,
+    local_axis: str,
+    *,
+    self_weight: Optional[float] = None,
+):
+    """Intra-machine average -> machine-level gossip -> (implicit) local
+    broadcast (reference ``bf.hierarchical_neighbor_allreduce``: local
+    allreduce, cross-machine neighbor exchange, local bcast — SURVEY.md
+    §2.1 NCCL-controller row [U]).
+
+    On the factored ``(machines, local)`` mesh the local pmean already leaves
+    every local rank with the machine value, so the machine-level gossip
+    runs replicated across the local axis and no final broadcast is needed.
+    """
+
+    def hnar(a):
+        wdt = _weight_dtype(a)
+        local_avg = lax.pmean(a.astype(wdt), local_axis)
+        return neighbor_allreduce(
+            local_avg, machine_plan, machines_axis, self_weight=self_weight
+        )
+
+    return jax.tree_util.tree_map(hnar, x)
+
+
+def pairwise_gossip(
+    x,
+    send_to: Tuple[Tuple[int, int], ...],
+    size: int,
+    axis_name: str,
+    *,
+    self_weight: float = 0.5,
+    peer_weight: float = 0.5,
+):
+    """One-peer dynamic gossip step: a single ``ppermute`` along the given
+    (src, dst) pairs plus weighted combine — the lowering of the reference's
+    dynamic one-peer topologies (``GetDynamicOnePeerSendRecvRanks`` [U]).
+
+    Ranks that receive nothing this step keep their value (weight 1)."""
+    recv_ranks = {d for _, d in send_to}
+    mask_host = [1.0 if d in recv_ranks else 0.0 for d in range(size)]
+
+    def g(a):
+        wdt = _weight_dtype(a)
+        recvd = lax.ppermute(a.astype(wdt), axis_name, send_to)
+        idx = lax.axis_index(axis_name)
+        mask = jnp.asarray(mask_host, dtype=wdt)[idx]
+        keep = self_weight + (1.0 - mask) * peer_weight
+        return keep * a.astype(wdt) + (mask * peer_weight) * recvd
+
+    return jax.tree_util.tree_map(g, x)

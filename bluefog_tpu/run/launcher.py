@@ -1,0 +1,97 @@
+"""``bftpu-run`` — TPU-slice launcher, sibling of the reference's ``bfrun``.
+
+The reference's ``bfrun`` (``bluefog/run/run.py`` [U], SURVEY.md §3.5)
+assembles and execs an ``mpirun`` command: NIC probing, env forwarding,
+one process per rank.  On TPU pods the platform already provides the
+process-per-host convention and rendezvous (``jax.distributed.initialize``
+auto-configures from the TPU environment), so the launcher's job shrinks
+to: validate the environment, set Bluefog env vars, optionally configure a
+multi-process CPU simulation, and exec the training script.
+
+Usage:
+  bftpu-run python train.py                    # on a TPU host/pod worker
+  bftpu-run --simulate 8 python train.py       # 8 virtual CPU devices
+  bftpu-run -np 4 --coordinator host:port --process-id K python train.py
+                                               # explicit multi-host bootstrap
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+__all__ = ["main", "build_env"]
+
+
+def build_env(args, base_env=None) -> dict:
+    """Compute the child environment (separated from exec for testability)."""
+    env = dict(os.environ if base_env is None else base_env)
+    if args.simulate:
+        flags = env.get("XLA_FLAGS", "")
+        token = f"--xla_force_host_platform_device_count={args.simulate}"
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (flags + " " + token).strip()
+        env["JAX_PLATFORMS"] = "cpu"
+    if args.verbose:
+        env["BLUEFOG_LOG_LEVEL"] = "debug"
+    if args.timeline:
+        env["BLUEFOG_TIMELINE"] = args.timeline
+    # Multi-host bootstrap: forwarded to jax.distributed.initialize via env
+    # (JAX reads these standard variables).
+    if args.coordinator:
+        env["JAX_COORDINATOR_ADDRESS"] = args.coordinator
+    if args.np is not None:
+        env["JAX_NUM_PROCESSES"] = str(args.np)
+    if args.process_id is not None:
+        env["JAX_PROCESS_ID"] = str(args.process_id)
+    return env
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="bftpu-run",
+        description="Launch a bluefog_tpu training script on a TPU slice "
+        "(or a simulated CPU mesh).",
+    )
+    parser.add_argument(
+        "-np",
+        type=int,
+        default=None,
+        help="total number of processes (multi-host; maps to JAX_NUM_PROCESSES)",
+    )
+    parser.add_argument(
+        "--coordinator",
+        default=None,
+        help="coordinator address host:port for multi-host rendezvous",
+    )
+    parser.add_argument(
+        "--process-id", type=int, default=None, help="this process's index"
+    )
+    parser.add_argument(
+        "--simulate",
+        type=int,
+        default=0,
+        metavar="N",
+        help="run on N virtual CPU devices instead of TPU (testing)",
+    )
+    parser.add_argument("--timeline", default=None, help="write a Chrome trace here")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("command", nargs=argparse.REMAINDER, help="program to run")
+    args = parser.parse_args(argv)
+
+    if not args.command:
+        parser.error("no command given; usage: bftpu-run [options] python train.py")
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    env = build_env(args)
+    try:
+        os.execvpe(cmd[0], cmd, env)
+    except FileNotFoundError:
+        print(f"bftpu-run: command not found: {cmd[0]}", file=sys.stderr)
+        return 127
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,139 @@
+"""Timeline: named activity spans + chrome-trace output.
+
+TPU-native sibling of the reference's ``bluefog/common/timeline.h/.cc`` [U]
+(SURVEY.md §5.1): the reference's background loop stamps per-tensor activity
+spans into a Chrome-tracing JSON file when ``BLUEFOG_TIMELINE=<path>`` is
+set.  Here spans wrap op dispatch on the controller thread and are emitted
+two ways at once:
+
+- ``jax.profiler.TraceAnnotation`` so spans show up inside XLA/TPU profiles
+  (the idiomatic TPU path — device-side timing comes from ``jax.profiler``).
+- a Chrome-tracing JSON file (same format the reference emits) when
+  ``BLUEFOG_TIMELINE`` is set, written by the native C++ writer
+  (``cbluefog`` — sibling of ``timeline.cc``) with a pure-Python fallback.
+
+``timeline_start_activity`` / ``timeline_end_activity`` mirror the
+reference's custom-span toggles [U].
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+import jax.profiler
+
+from bluefog_tpu.common.logging_util import logger
+
+__all__ = [
+    "timeline_start_activity",
+    "timeline_end_activity",
+    "timeline_context",
+    "TimelineWriter",
+]
+
+
+class TimelineWriter:
+    """Chrome-tracing JSON writer (reference ``TimelineWriter`` [U]).
+
+    Prefers the native C++ writer from :mod:`bluefog_tpu.native`; falls back
+    to a buffered pure-Python implementation.  Thread-safe.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._events = []
+        self._t0 = time.perf_counter_ns()
+        self._native = None
+        try:
+            from bluefog_tpu.native import timeline_native
+
+            self._native = timeline_native.NativeTimelineWriter(path)
+        except Exception:  # pragma: no cover - native lib optional
+            self._native = None
+        atexit.register(self.flush)
+
+    def _now_us(self) -> float:
+        return (time.perf_counter_ns() - self._t0) / 1e3
+
+    def record(self, name: str, start_us: float, dur_us: float, tid: int = 0) -> None:
+        if self._native is not None:
+            self._native.record(name, start_us, dur_us, tid)
+            return
+        with self._lock:
+            self._events.append(
+                {
+                    "name": name,
+                    "ph": "X",
+                    "ts": start_us,
+                    "dur": dur_us,
+                    "pid": os.getpid(),
+                    "tid": tid,
+                }
+            )
+
+    def flush(self) -> None:
+        if self._native is not None:
+            self._native.flush()
+            return
+        with self._lock:
+            if not self._events:
+                return
+            try:
+                with open(self.path, "w") as f:
+                    json.dump({"traceEvents": self._events}, f)
+            except OSError as e:  # pragma: no cover
+                logger.warning("timeline flush failed: %s", e)
+
+
+_writer: Optional[TimelineWriter] = None
+_open_spans = {}
+
+
+def _get_writer() -> Optional[TimelineWriter]:
+    global _writer
+    if _writer is None:
+        path = os.environ.get("BLUEFOG_TIMELINE")
+        if path:
+            _writer = TimelineWriter(path)
+    return _writer
+
+
+def timeline_start_activity(name: str, category: str = "custom") -> bool:
+    """Open a named span (reference ``bf.timeline_start_activity`` [U])."""
+    w = _get_writer()
+    _open_spans[(name, category)] = time.perf_counter_ns()
+    return w is not None
+
+
+def timeline_end_activity(name: str, category: str = "custom") -> bool:
+    """Close a span opened by :func:`timeline_start_activity`."""
+    start = _open_spans.pop((name, category), None)
+    w = _get_writer()
+    if start is None:
+        return False
+    if w is not None:
+        t0_us = (start - w._t0) / 1e3
+        dur_us = (time.perf_counter_ns() - start) / 1e3
+        w.record(f"{category}/{name}", t0_us, dur_us)
+    return w is not None
+
+
+@contextlib.contextmanager
+def timeline_context(name: str):
+    """Span around an op dispatch; also a ``jax.profiler`` annotation so the
+    span is visible in TPU traces."""
+    start = time.perf_counter_ns()
+    with jax.profiler.TraceAnnotation(f"bluefog/{name}"):
+        yield
+    w = _get_writer()
+    if w is not None:
+        t0_us = (start - w._t0) / 1e3
+        dur_us = (time.perf_counter_ns() - start) / 1e3
+        w.record(name, t0_us, dur_us)

@@ -1,0 +1,441 @@
+"""Virtual-topology library: weighted digraph constructors + dynamic generators.
+
+TPU-native sibling of the reference's ``bluefog/common/topology_util.py`` [U]
+(SURVEY.md §2.2).  A *topology* is a ``networkx.DiGraph`` over ranks
+``0..size-1`` whose edge ``(u, v)`` means "rank v receives rank u's tensor",
+with edge attribute ``weight`` = the combine coefficient receiver ``v``
+assigns to ``u``'s value.  Every constructor produces a **row-stochastic**
+mixing matrix ``W`` (``W[v, u]`` = weight of ``u``'s value at ``v``;
+``W[v, v] = 1 - sum of in-weights``), the invariant decentralized averaging
+needs for convergence (arXiv:2111.04287 §2).
+
+Graphs whose mixing matrix is also column-stochastic (all constructors here
+except ``StarGraph``/``MeshGrid2DGraph`` with default uniform weights on
+irregular degree distributions — those use Metropolis–Hastings weights to
+restore double stochasticity) preserve the global average exactly.
+
+Dynamic-topology generators yield per-step ``(send_ranks, recv_ranks)``
+pairs implementing one-peer rotating gossip; on TPU each step lowers to a
+single ``lax.ppermute`` along the ICI torus.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import networkx as nx
+import numpy as np
+
+__all__ = [
+    "ExponentialTwoGraph",
+    "ExponentialGraph",
+    "SymmetricExponentialGraph",
+    "RingGraph",
+    "StarGraph",
+    "MeshGrid2DGraph",
+    "FullyConnectedGraph",
+    "IsRegularGraph",
+    "IsTopologyEquivalent",
+    "GetRecvWeights",
+    "GetSendWeights",
+    "GetWeightMatrix",
+    "GetDynamicOnePeerSendRecvRanks",
+    "GetInnerOuterRingDynamicSendRecvRanks",
+    "GetInnerOuterExpo2DynamicSendRecvRanks",
+    "GetExp2DynamicSendRecvMachineRanks",
+    "InferSourceFromDestinationRanks",
+    "InferDestinationFromSourceRanks",
+]
+
+
+def _check_size(size: int) -> None:
+    if not isinstance(size, (int, np.integer)) or size < 1:
+        raise ValueError(f"topology size must be a positive int, got {size!r}")
+
+
+def _finalize(G: nx.DiGraph, weighted: bool) -> nx.DiGraph:
+    """Stamp bookkeeping attributes used by GetRecvWeights / the core plan
+    compiler."""
+    G.graph["weighted"] = weighted
+    return G
+
+
+def _uniform_in_weights(G: nx.DiGraph) -> None:
+    """Assign each in-edge of v the weight 1/(in_degree(v)+1).
+
+    Self weight (implicit) becomes the same 1/(d+1): the uniform-average
+    convention of the reference's exp/ring constructors [U].
+    """
+    for v in G.nodes:
+        d = G.in_degree(v)
+        for u in G.predecessors(v):
+            G[u][v]["weight"] = 1.0 / (d + 1)
+
+
+def ExponentialTwoGraph(size: int) -> nx.DiGraph:
+    """Static exponential-2 digraph: rank i receives from (i - 2^j) % size and
+    sends to (i + 2^j) % size for j = 0..ceil(log2(size))-1.
+
+    The reference's flagship topology (``topology_util.ExponentialTwoGraph``
+    [U]): O(log n) degree, spectral gap good enough that gossip matches
+    allreduce convergence.  On a TPU ICI torus the 2^j hops map to repeated
+    doubling ``ppermute`` shifts.
+    """
+    _check_size(size)
+    G = nx.DiGraph()
+    G.add_nodes_from(range(size))
+    if size > 1:
+        nbits = int(math.ceil(math.log2(size)))
+        offsets = sorted({(1 << j) % size for j in range(nbits)} - {0})
+        for i in range(size):
+            for off in offsets:
+                G.add_edge((i - off) % size, i)
+    _uniform_in_weights(G)
+    return _finalize(G, weighted=False)
+
+
+def ExponentialGraph(size: int, base: int = 2) -> nx.DiGraph:
+    """Exponential digraph with offsets base^j for all j with base^j < size.
+
+    Equals ``ExponentialTwoGraph`` when ``size`` is a power of ``base``
+    (reference ``topology_util.ExponentialGraph`` [U]).
+    """
+    _check_size(size)
+    if base < 2:
+        raise ValueError("base must be >= 2")
+    G = nx.DiGraph()
+    G.add_nodes_from(range(size))
+    offsets = []
+    off = 1
+    while off < size:
+        offsets.append(off)
+        off *= base
+    for i in range(size):
+        for off in offsets:
+            G.add_edge((i - off) % size, i)
+    _uniform_in_weights(G)
+    return _finalize(G, weighted=False)
+
+
+def SymmetricExponentialGraph(size: int, base: int = 4) -> nx.DiGraph:
+    """Exponential graph with symmetric offsets ±base^j (reference
+    ``topology_util.SymmetricExponentialGraph`` [U]).  The resulting mixing
+    matrix is symmetric hence doubly stochastic.
+    """
+    _check_size(size)
+    if base < 2:
+        raise ValueError("base must be >= 2")
+    G = nx.DiGraph()
+    G.add_nodes_from(range(size))
+    offsets = set()
+    off = 1
+    while off < size:
+        offsets.add(off % size)
+        offsets.add((-off) % size)
+        off *= base
+    offsets -= {0}
+    for i in range(size):
+        for off in sorted(offsets):
+            G.add_edge((i - off) % size, i)
+    _uniform_in_weights(G)
+    return _finalize(G, weighted=False)
+
+
+def RingGraph(size: int, connect_style: int = 0) -> nx.DiGraph:
+    """Ring topology (reference ``topology_util.RingGraph`` [U]).
+
+    connect_style 0: bidirectional (receive from both ring neighbors);
+    1: unidirectional, receive from left  (i-1 -> i);
+    2: unidirectional, receive from right (i+1 -> i).
+
+    Maps 1:1 onto a wraparound ICI torus axis — each step is one physical hop.
+    """
+    _check_size(size)
+    if connect_style not in (0, 1, 2):
+        raise ValueError(f"connect_style must be 0, 1, or 2, got {connect_style}")
+    G = nx.DiGraph()
+    G.add_nodes_from(range(size))
+    if size > 1:
+        for i in range(size):
+            if connect_style in (0, 1):
+                G.add_edge((i - 1) % size, i)
+            if connect_style in (0, 2) and size > 2:
+                G.add_edge((i + 1) % size, i)
+            elif connect_style == 2 and size == 2:
+                G.add_edge((i + 1) % size, i)
+    _uniform_in_weights(G)
+    return _finalize(G, weighted=False)
+
+
+def StarGraph(size: int, center_rank: int = 0) -> nx.DiGraph:
+    """Star topology: every rank exchanges with ``center_rank`` only
+    (reference ``topology_util.StarGraph`` [U]).
+
+    Degrees are irregular, so uniform 1/(d+1) weights would not be doubly
+    stochastic; Metropolis–Hastings weights
+    ``w_uv = 1 / (1 + max(deg(u), deg(v)))`` restore it, preserving the
+    global average under gossip.
+    """
+    _check_size(size)
+    if not 0 <= center_rank < size:
+        raise ValueError("center_rank out of range")
+    G = nx.DiGraph()
+    G.add_nodes_from(range(size))
+    for i in range(size):
+        if i != center_rank:
+            G.add_edge(center_rank, i)
+            G.add_edge(i, center_rank)
+    _metropolis_hastings_weights(G)
+    return _finalize(G, weighted=True)
+
+
+def _metropolis_hastings_weights(G: nx.DiGraph) -> None:
+    for u, v in G.edges:
+        G[u][v]["weight"] = 1.0 / (1 + max(G.in_degree(u), G.in_degree(v)))
+
+
+def MeshGrid2DGraph(size: int, shape: Optional[Tuple[int, int]] = None) -> nx.DiGraph:
+    """2-D (non-wraparound) grid with 4-neighborhood and Metropolis–Hastings
+    weights (reference ``topology_util.MeshGrid2DGraph`` [U]).
+
+    ``shape`` defaults to the most-square factorization of ``size``.
+    """
+    _check_size(size)
+    if shape is None:
+        a = int(math.sqrt(size))
+        while size % a != 0:
+            a -= 1
+        shape = (a, size // a)
+    nrow, ncol = shape
+    if nrow * ncol != size:
+        raise ValueError(f"shape {shape} does not factor size {size}")
+    G = nx.DiGraph()
+    G.add_nodes_from(range(size))
+    for r in range(nrow):
+        for c in range(ncol):
+            i = r * ncol + c
+            if c + 1 < ncol:
+                j = i + 1
+                G.add_edge(i, j)
+                G.add_edge(j, i)
+            if r + 1 < nrow:
+                j = i + ncol
+                G.add_edge(i, j)
+                G.add_edge(j, i)
+    _metropolis_hastings_weights(G)
+    G.graph["shape"] = (nrow, ncol)
+    return _finalize(G, weighted=True)
+
+
+def FullyConnectedGraph(size: int) -> nx.DiGraph:
+    """Complete digraph, weight 1/size everywhere: one gossip step equals a
+    global average (reference ``topology_util.FullyConnectedGraph`` [U])."""
+    _check_size(size)
+    G = nx.DiGraph()
+    G.add_nodes_from(range(size))
+    for i, j in itertools.permutations(range(size), 2):
+        G.add_edge(i, j, weight=1.0 / size)
+    return _finalize(G, weighted=True)
+
+
+# --------------------------------------------------------------------------
+# Introspection helpers
+# --------------------------------------------------------------------------
+
+
+def IsRegularGraph(topo: nx.DiGraph) -> bool:
+    """True iff every node has the same in-degree and the same out-degree
+    (reference ``topology_util.IsRegularGraph`` [U])."""
+    degs_in = {d for _, d in topo.in_degree()}
+    degs_out = {d for _, d in topo.out_degree()}
+    return len(degs_in) <= 1 and len(degs_out) <= 1
+
+
+def IsTopologyEquivalent(topo1: Optional[nx.DiGraph], topo2: Optional[nx.DiGraph]) -> bool:
+    """Node/edge/weight equality up to float tolerance (reference
+    ``topology_util.IsTopologyEquivalent`` [U])."""
+    if topo1 is None or topo2 is None:
+        return topo1 is topo2
+    if set(topo1.nodes) != set(topo2.nodes):
+        return False
+    if set(topo1.edges) != set(topo2.edges):
+        return False
+    for u, v in topo1.edges:
+        w1 = topo1[u][v].get("weight", 1.0)
+        w2 = topo2[u][v].get("weight", 1.0)
+        if abs(w1 - w2) > 1e-12:
+            return False
+    return True
+
+
+def GetRecvWeights(topo: nx.DiGraph, rank: int) -> Tuple[float, Dict[int, float]]:
+    """(self_weight, {in_neighbor: weight}) for ``rank``; self weight is
+    1 - sum(in-weights) (reference ``topology_util.GetRecvWeights`` [U])."""
+    recv = {int(u): float(topo[u][rank]["weight"]) for u in topo.predecessors(rank)}
+    return 1.0 - sum(recv.values()), recv
+
+
+def GetSendWeights(topo: nx.DiGraph, rank: int) -> Tuple[float, Dict[int, float]]:
+    """(self_weight, {out_neighbor: weight dst assigns to us}) (reference
+    ``topology_util.GetSendWeights`` [U])."""
+    send = {int(v): float(topo[rank][v]["weight"]) for v in topo.successors(rank)}
+    return 1.0 - sum(send.values()), send
+
+
+def GetWeightMatrix(topo: nx.DiGraph) -> np.ndarray:
+    """Dense mixing matrix W with W[v, u] = weight of u's value at v and
+    W[v, v] = self weight.  Rows sum to 1 by construction."""
+    n = topo.number_of_nodes()
+    W = np.zeros((n, n))
+    for v in range(n):
+        sw, recv = GetRecvWeights(topo, v)
+        W[v, v] = sw
+        for u, w in recv.items():
+            W[v, u] = w
+    return W
+
+
+# --------------------------------------------------------------------------
+# Dynamic (per-step) topology generators
+# --------------------------------------------------------------------------
+
+
+def GetDynamicOnePeerSendRecvRanks(
+    size: int, self_rank: int
+) -> Iterator[Tuple[List[int], List[int]]]:
+    """Infinite generator of one-peer exp-2 rotations: at step t each rank
+    sends to (rank + 2^(t mod nbits)) and receives from (rank - 2^(t mod
+    nbits)) (reference ``topology_util.GetDynamicOnePeerSendRecvRanks`` [U]).
+
+    Every step the edge set is a single permutation — exactly one
+    ``lax.ppermute`` on TPU.
+    """
+    _check_size(size)
+    if not 0 <= self_rank < size:
+        raise ValueError("self_rank out of range")
+    nbits = max(1, int(math.ceil(math.log2(size)))) if size > 1 else 1
+    for t in itertools.count():
+        if size == 1:
+            yield [], []
+            continue
+        off = (1 << (t % nbits)) % size
+        if off == 0:
+            off = 1
+        yield [(self_rank + off) % size], [(self_rank - off) % size]
+
+
+def GetInnerOuterRingDynamicSendRecvRanks(
+    world_size: int, local_size: int, self_rank: int
+) -> Iterator[Tuple[List[int], List[int]]]:
+    """Alternate an intra-machine ("inner") ring step with a cross-machine
+    ("outer") ring step at fixed local index (reference
+    ``topology_util.GetInnerOuterRingDynamicSendRecvRanks`` [U]).
+    """
+    _check_size(world_size)
+    if world_size % local_size != 0:
+        raise ValueError("world_size must be a multiple of local_size")
+    nmachines = world_size // local_size
+    machine, lrank = divmod(self_rank, local_size)
+    for t in itertools.count():
+        if t % 2 == 0 and local_size > 1:
+            send = machine * local_size + (lrank + 1) % local_size
+            recv = machine * local_size + (lrank - 1) % local_size
+            yield [send], [recv]
+        elif nmachines > 1:
+            send = ((machine + 1) % nmachines) * local_size + lrank
+            recv = ((machine - 1) % nmachines) * local_size + lrank
+            yield [send], [recv]
+        else:
+            yield [], []
+
+
+def GetInnerOuterExpo2DynamicSendRecvRanks(
+    world_size: int, local_size: int, self_rank: int
+) -> Iterator[Tuple[List[int], List[int]]]:
+    """Alternate intra-machine exp-2 rotation with cross-machine exp-2
+    rotation at fixed local index (reference
+    ``topology_util.GetInnerOuterExpo2DynamicSendRecvRanks`` [U])."""
+    _check_size(world_size)
+    if world_size % local_size != 0:
+        raise ValueError("world_size must be a multiple of local_size")
+    nmachines = world_size // local_size
+    machine, lrank = divmod(self_rank, local_size)
+    in_bits = max(1, int(math.ceil(math.log2(local_size)))) if local_size > 1 else 1
+    out_bits = max(1, int(math.ceil(math.log2(nmachines)))) if nmachines > 1 else 1
+    ti = to = 0
+    for t in itertools.count():
+        if t % 2 == 0 and local_size > 1:
+            off = (1 << (ti % in_bits)) % local_size or 1
+            ti += 1
+            send = machine * local_size + (lrank + off) % local_size
+            recv = machine * local_size + (lrank - off) % local_size
+            yield [send], [recv]
+        elif nmachines > 1:
+            off = (1 << (to % out_bits)) % nmachines or 1
+            to += 1
+            send = ((machine + off) % nmachines) * local_size + lrank
+            recv = ((machine - off) % nmachines) * local_size + lrank
+            yield [send], [recv]
+        else:
+            yield [], []
+
+
+def GetExp2DynamicSendRecvMachineRanks(
+    world_size: int, local_size: int, self_rank: int, local_rank: int
+) -> Iterator[Tuple[List[int], List[int]]]:
+    """Machine-level one-peer exp-2 rotation for hierarchical ops: yields
+    *machine* indices, only meaningful for ranks with ``local_rank == 0``
+    (reference ``topology_util.GetExp2DynamicSendRecvMachineRanks`` [U])."""
+    _check_size(world_size)
+    if world_size % local_size != 0:
+        raise ValueError("world_size must be a multiple of local_size")
+    nmachines = world_size // local_size
+    machine = self_rank // local_size
+    bits = max(1, int(math.ceil(math.log2(nmachines)))) if nmachines > 1 else 1
+    for t in itertools.count():
+        if nmachines == 1 or local_rank != 0:
+            yield [], []
+            continue
+        off = (1 << (t % bits)) % nmachines or 1
+        yield [(machine + off) % nmachines], [(machine - off) % nmachines]
+
+
+# --------------------------------------------------------------------------
+# Rank-inference helpers
+# --------------------------------------------------------------------------
+#
+# In the reference these are *collective* calls (each rank contributes its
+# list and an allgather assembles the global picture) [U].  Under JAX's
+# single-controller SPMD model the global picture is already in one process,
+# so these are pure functions over all ranks' lists.
+
+
+def InferDestinationFromSourceRanks(
+    src_ranks: Sequence[Sequence[int]],
+) -> List[List[int]]:
+    """Given per-rank *source* lists (src_ranks[r] = ranks r receives from),
+    return per-rank *destination* lists (who r must send to)."""
+    n = len(src_ranks)
+    dst: List[List[int]] = [[] for _ in range(n)]
+    for r, srcs in enumerate(src_ranks):
+        for s in srcs:
+            if not 0 <= s < n:
+                raise ValueError(f"rank {r} lists out-of-range source {s}")
+            dst[s].append(r)
+    return [sorted(d) for d in dst]
+
+
+def InferSourceFromDestinationRanks(
+    dst_ranks: Sequence[Sequence[int]],
+) -> List[List[int]]:
+    """Given per-rank *destination* lists, return per-rank *source* lists."""
+    n = len(dst_ranks)
+    src: List[List[int]] = [[] for _ in range(n)]
+    for r, dsts in enumerate(dst_ranks):
+        for d in dsts:
+            if not 0 <= d < n:
+                raise ValueError(f"rank {r} lists out-of-range destination {d}")
+            src[d].append(r)
+    return [sorted(s) for s in src]

@@ -1,0 +1,442 @@
+"""One-sided window ops — device-memory mailbox emulation.
+
+TPU-native sibling of the reference's RMA window layer
+(``bluefog/torch/mpi_win_ops.cc``, ``MPI_Win_create/Put/Get/Accumulate``
+paths in ``bluefog/common/mpi_controller.cc`` [U]; SURVEY.md §3.4, §7
+stage 5).  The reference gives every rank one registered buffer **per
+in-neighbor** per named window so concurrent writers never collide; a
+``win_put`` deposits into the writer's dedicated slot at the destination and
+``win_update`` locally combines the slots.
+
+XLA has no one-sided RMA, so the same window model is emulated with
+rank-major mailbox arrays living in device memory:
+
+- ``win_create(name)`` allocates ``mail[size, max_in_degree, ...]`` — rank
+  d's slot k holds the last deposit from its k-th in-neighbor (ascending
+  rank order), exactly the reference's per-writer-buffer model.
+- ``win_put/win_get/win_accumulate`` lower to one ``lax.ppermute`` per shift
+  class of the window's topology, scattering into the destination slots.
+- ``win_update`` is the purely local weighted combine, as upstream.
+
+Semantic deviation (documented, by design): deposits are dispatched
+asynchronously by the JAX runtime but become visible at the next collective
+exchange point, so the execution realizes the *synchronous schedule* of the
+asynchronous algorithm (bounded staleness 0).  Every consensus/push-sum
+algorithm expressible upstream runs unchanged; what is lost is only
+wall-clock desynchronization between ranks.  ``win_mutex`` therefore
+degenerates to a no-op shim (SURVEY.md §5.2): there are never concurrent
+writers to a slot.
+
+Push-sum support: when associated-p mode is on (reference
+``turn_on_win_ops_with_associated_p`` [U]) a scalar weight p rides along
+with every deposit and is combined identically, enabling directed-graph
+push-sum averaging (x/p debiasing).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from bluefog_tpu.core import basics
+from bluefog_tpu.core.basics import NODES_AXIS
+from bluefog_tpu.core.plan import CommPlan
+from bluefog_tpu.timeline import timeline_context
+
+__all__ = [
+    "win_create",
+    "win_free",
+    "win_put",
+    "win_put_nonblocking",
+    "win_get",
+    "win_get_nonblocking",
+    "win_accumulate",
+    "win_accumulate_nonblocking",
+    "win_update",
+    "win_update_then_collect",
+    "win_wait",
+    "win_poll",
+    "win_mutex",
+    "get_win_version",
+    "win_associated_p",
+    "turn_on_win_ops_with_associated_p",
+    "turn_off_win_ops_with_associated_p",
+]
+
+WeightsArg = Union[None, Sequence[Dict[int, float]]]
+
+
+class _Window:
+    """Per-name window state (the reference's window registry entry [U])."""
+
+    def __init__(self, name: str, tensor: jnp.ndarray, plan: CommPlan, zero_init: bool):
+        ctx = basics.context()
+        self.name = name
+        self.plan = plan
+        self.shape = tensor.shape  # rank-major [size, ...]
+        self.dtype = tensor.dtype
+        maxd = max(plan.max_in_degree, 1)
+        self.self_tensor = jnp.asarray(tensor)
+        init = jnp.zeros((ctx.size, maxd) + tensor.shape[1:], dtype=tensor.dtype)
+        if not zero_init:
+            # Reference initializes each neighbor buffer with the local
+            # tensor value so a pre-put win_update is a no-op average.
+            init = init + jnp.expand_dims(jnp.asarray(tensor), 1)
+        self.mail = init
+        self.versions = jnp.zeros((ctx.size, maxd), dtype=jnp.int32)
+        # push-sum associated scalars (mailbox follows the tensor-mailbox
+        # init convention: zero_init -> empty, else neighbor's initial p=1)
+        self.p_self = jnp.ones((ctx.size,), dtype=jnp.float32)
+        self.p_mail = (
+            jnp.zeros((ctx.size, maxd), dtype=jnp.float32)
+            if zero_init
+            else jnp.ones((ctx.size, maxd), dtype=jnp.float32)
+        )
+
+
+def _ctx():
+    return basics.context()
+
+
+def _win(name: str) -> _Window:
+    w = _ctx().windows.get(name)
+    if w is None:
+        raise KeyError(f"no window named {name!r}; call win_create first")
+    return w
+
+
+def _class_scales(
+    plan: CommPlan,
+    weights: WeightsArg,
+    side: str,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-class scale + active-edge mask, both [num_classes, size] indexed
+    by the *receiving* rank's mask position.
+
+    side='send': scales[c, s] = weight rank s applies to what it sends in
+    class c (keyed by that class's destination) — the reference's
+    ``dst_weights``.  side='recv': scales[c, d] = weight rank d applies to
+    what it receives in class c — the reference's ``src_weights``.
+
+    When a weights sequence is given it also *selects* the edges: an edge
+    not listed in the dict does not transfer at all (the reference's
+    selective put/get — a put with ``dst_weights={1: w}`` touches only rank
+    1's window [U]).  ``active[c, d] = 0`` suppresses the slot update at
+    receiver d for that class.
+    """
+    C = len(plan.classes)
+    scales = np.ones((C, plan.size), dtype=np.float32)
+    active = np.ones((C, plan.size), dtype=np.float32)
+    if weights is None:
+        return scales, active
+    if len(weights) != plan.size:
+        raise ValueError(f"weights must be a length-{plan.size} sequence of dicts")
+    for c, cls in enumerate(plan.classes):
+        for s, d in cls.perm:
+            listed = d in weights[s] if side == "send" else s in weights[d]
+            if not listed:
+                active[c, d] = 0.0
+                scales[c, s if side == "send" else d] = 0.0
+            elif side == "send":
+                scales[c, s] = float(weights[s][d])
+            else:
+                scales[c, d] = float(weights[d][s])
+    return scales, active
+
+
+def _build_exchange(plan: CommPlan, accumulate: bool, with_p: bool):
+    """Jitted rank-major exchange: deposit (scaled) payloads into destination
+    mailbox slots — the ppermute lowering of MPI_Put/MPI_Accumulate [U]."""
+    ctx = _ctx()
+    maxd = max(plan.max_in_degree, 1)
+
+    def spmd(x, mail, versions, p_self, p_mail, scales, active):
+        # local shapes: x [1,...], mail [1,maxd,...], versions [1,maxd],
+        # p_self [1], p_mail [1,maxd], scales/active [C,1] (sharded by rank)
+        idx = lax.axis_index(NODES_AXIS)
+        mail0 = mail[0]
+        ver0 = versions[0]
+        pm0 = p_mail[0]
+        for c, cls in enumerate(plan.classes):
+            wdt = x.dtype if jnp.issubdtype(x.dtype, jnp.inexact) else jnp.float32
+            scale = scales[c, 0].astype(wdt)
+            payload = (x[0].astype(wdt) * scale).astype(x.dtype)
+            recvd = lax.ppermute(payload, NODES_AXIS, cls.perm)
+            slot = jnp.asarray(cls.slot_index)[idx]
+            valid = jnp.asarray(cls.recv_mask)[idx].astype(bool) & (active[c, 0] > 0)
+            slot_c = jnp.maximum(slot, 0)
+            cur = lax.dynamic_index_in_dim(mail0, slot_c, axis=0, keepdims=False)
+            new = cur + recvd if accumulate else recvd
+            mail0 = jnp.where(
+                valid, lax.dynamic_update_index_in_dim(mail0, new, slot_c, axis=0), mail0
+            )
+            ver0 = jnp.where(
+                valid,
+                lax.dynamic_update_index_in_dim(
+                    ver0, lax.dynamic_index_in_dim(ver0, slot_c, 0, keepdims=False) + 1,
+                    slot_c, axis=0,
+                ),
+                ver0,
+            )
+            if with_p:
+                p_recvd = lax.ppermute(p_self[0] * scales[c, 0], NODES_AXIS, cls.perm)
+                p_cur = lax.dynamic_index_in_dim(pm0, slot_c, 0, keepdims=False)
+                p_new = p_cur + p_recvd if accumulate else p_recvd
+                pm0 = jnp.where(
+                    valid,
+                    lax.dynamic_update_index_in_dim(pm0, p_new, slot_c, axis=0),
+                    pm0,
+                )
+        return mail0[None], ver0[None], pm0[None]
+
+    mesh = ctx.mesh
+    return jax.jit(
+        jax.shard_map(
+            spmd,
+            mesh=mesh,
+            in_specs=(P(NODES_AXIS), P(NODES_AXIS), P(NODES_AXIS), P(NODES_AXIS),
+                      P(NODES_AXIS), P(None, NODES_AXIS), P(None, NODES_AXIS)),
+            out_specs=(P(NODES_AXIS), P(NODES_AXIS), P(NODES_AXIS)),
+        )
+    )
+
+
+def _exchange(
+    win: _Window, x, scales: np.ndarray, active: np.ndarray, accumulate: bool
+) -> None:
+    ctx = _ctx()
+    with_p = ctx.win_associated_p_enabled
+    key = ("win_exchange", win.plan, accumulate, with_p, win.dtype, win.shape[1:])
+    f = ctx.jit_cache(key, lambda: _build_exchange(win.plan, accumulate, with_p))
+    mail, versions, p_mail = f(
+        jnp.asarray(x, dtype=win.dtype),
+        win.mail,
+        win.versions,
+        win.p_self,
+        win.p_mail,
+        jnp.asarray(scales),
+        jnp.asarray(active),
+    )
+    win.mail, win.versions = mail, versions
+    if with_p:
+        win.p_mail = p_mail
+
+
+# --------------------------------------------------------------------------
+# Public API
+# --------------------------------------------------------------------------
+
+
+def win_create(tensor, name: str, zero_init: bool = False) -> bool:
+    """Collectively create a named window from a rank-major tensor
+    (reference ``bf.win_create(tensor, name, zero_init)`` [U]).  The window's
+    neighbor structure snapshots the currently-installed topology."""
+    ctx = _ctx()
+    t = jnp.asarray(tensor)
+    if t.shape[0] != ctx.size:
+        raise ValueError(
+            f"win_create expects rank-major tensor with leading dim {ctx.size}"
+        )
+    if name in ctx.windows:
+        return False
+    ctx.windows[name] = _Window(name, t, ctx.plan, zero_init)
+    return True
+
+
+def win_free(name: Optional[str] = None) -> bool:
+    """Free one window, or all when name is None (reference ``bf.win_free`` [U])."""
+    ctx = _ctx()
+    if name is None:
+        ctx.windows.clear()
+        return True
+    return ctx.windows.pop(name, None) is not None
+
+
+def win_put(tensor, name: str, dst_weights: WeightsArg = None) -> bool:
+    """Deposit (optionally dst-scaled) values into this rank's slot at each
+    out-neighbor — only the ranks listed in ``dst_weights`` when given
+    (reference ``bf.win_put`` — MPI_Put path [U]).
+
+    Also refreshes the window's exposed tensor: upstream the window aliases
+    the tensor's memory, so the put value *is* the current exposure.
+    """
+    with timeline_context("win_put"):
+        win = _win(name)
+        win.self_tensor = jnp.asarray(tensor, dtype=win.dtype)
+        scales, active = _class_scales(win.plan, dst_weights, side="send")
+        _exchange(win, tensor, scales, active, accumulate=False)
+    return True
+
+
+def win_put_nonblocking(tensor, name: str, dst_weights: WeightsArg = None):
+    from bluefog_tpu.ops import Handle
+
+    win_put(tensor, name, dst_weights)
+    return Handle(_win(name).mail)
+
+
+def win_accumulate(tensor, name: str, dst_weights: WeightsArg = None) -> bool:
+    """Like win_put but adds into the destination slot (reference
+    ``bf.win_accumulate`` — MPI_Accumulate path [U])."""
+    with timeline_context("win_accumulate"):
+        win = _win(name)
+        win.self_tensor = jnp.asarray(tensor, dtype=win.dtype)
+        scales, active = _class_scales(win.plan, dst_weights, side="send")
+        _exchange(win, tensor, scales, active, accumulate=True)
+    return True
+
+
+def win_accumulate_nonblocking(tensor, name: str, dst_weights: WeightsArg = None):
+    from bluefog_tpu.ops import Handle
+
+    win_accumulate(tensor, name, dst_weights)
+    return Handle(_win(name).mail)
+
+
+def win_get(name: str, src_weights: WeightsArg = None) -> bool:
+    """Pull in-neighbors' exposed tensors into my mailbox slots, optionally
+    receiver-scaled (reference ``bf.win_get`` — MPI_Get path [U])."""
+    with timeline_context("win_get"):
+        win = _win(name)
+        # A get of s's exposed tensor by d == a put of s's tensor to d with
+        # receiver-side scaling, under the lockstep schedule.
+        send, _ = _class_scales(win.plan, None, side="send")
+        recv, active = _class_scales(win.plan, src_weights, side="recv")
+        # apply receiver scale post-transfer by folding into sender scale:
+        # within a class each (s,d) is unique, so scale at sender by the
+        # destination's recv weight.
+        for c, cls in enumerate(win.plan.classes):
+            for s, d in cls.perm:
+                send[c, s] = recv[c, d]
+        _exchange(win, win.self_tensor, send, active, accumulate=False)
+    return True
+
+
+def win_get_nonblocking(name: str, src_weights: WeightsArg = None):
+    from bluefog_tpu.ops import Handle
+
+    win_get(name, src_weights)
+    return Handle(_win(name).mail)
+
+
+def win_update(
+    name: str,
+    self_weight: Optional[Union[float, Sequence[float]]] = None,
+    neighbor_weights: WeightsArg = None,
+    reset: bool = False,
+    clone: bool = False,
+):
+    """Local weighted combine of the exposed tensor with mailbox slots,
+    storing the result back as the exposed tensor (reference
+    ``bf.win_update(name, self_weight, neighbor_weights, reset, clone)``
+    [U]).  Default weights: uniform 1/(in_degree+1).  ``reset`` zeroes the
+    mailbox (and associated p) after reading — the accumulate idiom.
+    """
+    with timeline_context("win_update"):
+        ctx = _ctx()
+        win = _win(name)
+        plan = win.plan
+        size = ctx.size
+        maxd = max(plan.max_in_degree, 1)
+        # weight matrix [size, maxd] + self vector [size]
+        wmat = np.zeros((size, maxd), dtype=np.float32)
+        swvec = np.zeros((size,), dtype=np.float32)
+        for d in range(size):
+            nbrs = plan.in_neighbors[d]
+            if neighbor_weights is not None:
+                for k, s in enumerate(nbrs):
+                    wmat[d, k] = float(neighbor_weights[d].get(s, 0.0))
+            else:
+                for k in range(len(nbrs)):
+                    wmat[d, k] = 1.0 / (len(nbrs) + 1)
+            if self_weight is None:
+                swvec[d] = (
+                    1.0 - wmat[d].sum()
+                    if neighbor_weights is not None
+                    else 1.0 / (len(nbrs) + 1)
+                )
+            elif np.isscalar(self_weight):
+                swvec[d] = float(self_weight)
+            else:
+                swvec[d] = float(self_weight[d])
+
+        wdt = win.dtype if jnp.issubdtype(win.dtype, jnp.inexact) else jnp.float32
+        w = jnp.asarray(wmat, dtype=wdt).reshape(
+            (size, maxd) + (1,) * (len(win.shape) - 1)
+        )
+        sw = jnp.asarray(swvec, dtype=wdt).reshape((size,) + (1,) * (len(win.shape) - 1))
+        combined = sw * win.self_tensor.astype(wdt) + (
+            w * win.mail.astype(wdt)
+        ).sum(axis=1)
+        win.self_tensor = combined.astype(win.dtype)
+        if ctx.win_associated_p_enabled:
+            win.p_self = jnp.asarray(swvec) * win.p_self + (
+                jnp.asarray(wmat) * win.p_mail
+            ).sum(axis=1)
+        if reset:
+            win.mail = jnp.zeros_like(win.mail)
+            win.p_mail = jnp.zeros_like(win.p_mail)
+        out = win.self_tensor
+        return jnp.array(out) if clone else out
+
+
+def win_update_then_collect(name: str, require_mutex: bool = False):
+    """Collect-style update: self weight 1, every neighbor slot weight 1,
+    then reset — the push-sum accumulate-and-drain idiom (reference
+    ``bf.win_update_then_collect`` [U])."""
+    del require_mutex
+    ctx = _ctx()
+    win = _win(name)
+    ones = [
+        {s: 1.0 for s in win.plan.in_neighbors[d]} for d in range(ctx.size)
+    ]
+    return win_update(name, self_weight=1.0, neighbor_weights=ones, reset=True)
+
+
+def win_wait(handle) -> bool:
+    handle.wait()
+    return True
+
+
+def win_poll(handle) -> bool:
+    return handle.poll()
+
+
+@contextlib.contextmanager
+def win_mutex(name: str, for_self: bool = False, ranks: Optional[List[int]] = None):
+    """No-op shim kept for API parity (reference ``bf.win_mutex`` [U]): the
+    mailbox emulation is bulk-synchronous, so slot access is never
+    concurrent (SURVEY.md §5.2)."""
+    del name, for_self, ranks
+    yield
+
+
+def get_win_version(name: str) -> List[Dict[int, int]]:
+    """Per-rank {in_neighbor: deposit_count} (reference
+    ``bf.get_win_version`` [U])."""
+    win = _win(name)
+    ver = np.asarray(win.versions)
+    return [
+        {s: int(ver[d, k]) for k, s in enumerate(win.plan.in_neighbors[d])}
+        for d in range(win.plan.size)
+    ]
+
+
+def win_associated_p(name: str) -> jnp.ndarray:
+    """The push-sum associated scalar p per rank (reference
+    ``bf.win_associated_p`` [U])."""
+    return _win(name).p_self
+
+
+def turn_on_win_ops_with_associated_p() -> None:
+    _ctx().win_associated_p_enabled = True
+
+
+def turn_off_win_ops_with_associated_p() -> None:
+    _ctx().win_associated_p_enabled = False

@@ -1,0 +1,65 @@
+"""Average-consensus demo: pure gossip, no optimizer.
+
+JAX twin of the reference's ``examples/pytorch_average_consensus.py`` [U]
+(SURVEY.md §2.2): each rank starts from a random vector and repeatedly
+neighbor-averages until every rank holds the global mean.
+
+Run (CPU, 8 virtual ranks):
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/jax_average_consensus.py
+Run (TPU): python examples/jax_average_consensus.py
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import bluefog_tpu as bf
+from bluefog_tpu import topology_util
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--max-iters", type=int, default=200)
+    parser.add_argument("--dim", type=int, default=1000)
+    parser.add_argument(
+        "--topology",
+        default="exp2",
+        choices=["exp2", "ring", "mesh2d", "star", "full"],
+    )
+    parser.add_argument("--atol", type=float, default=1e-4)
+    args = parser.parse_args()
+
+    bf.init()
+    n = bf.size()
+    topo = {
+        "exp2": topology_util.ExponentialTwoGraph,
+        "ring": topology_util.RingGraph,
+        "mesh2d": topology_util.MeshGrid2DGraph,
+        "star": topology_util.StarGraph,
+        "full": topology_util.FullyConnectedGraph,
+    }[args.topology](n)
+    bf.set_topology(topo)
+    print(f"ranks={n} topology={args.topology} devices={jax.devices()[0].platform}")
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(n, args.dim)).astype(np.float32))
+    target = np.asarray(x).mean(axis=0)
+
+    for it in range(args.max_iters):
+        x = bf.neighbor_allreduce(x)
+        err = float(np.abs(np.asarray(x) - target).max())
+        if err < args.atol:
+            print(f"consensus reached at iter {it + 1}: max|x - mean| = {err:.2e}")
+            break
+    else:
+        print(f"no consensus after {args.max_iters} iters: max err {err:.2e}")
+        raise SystemExit(1)
+
+    bf.shutdown()
+
+
+if __name__ == "__main__":
+    main()

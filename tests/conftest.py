@@ -1,0 +1,32 @@
+"""Test harness: single-process SPMD over 8 virtual CPU devices.
+
+The reference tests multi-rank behaviour by running pytest under ``mpirun -np 4``
+on one machine (SURVEY.md §4).  The JAX-native analogue is better: force the CPU
+platform with ``xla_force_host_platform_device_count=8`` so one process owns an
+8-device mesh and every collective (psum/ppermute/all_to_all) runs for real.
+
+This must happen before any jax backend is initialised, hence conftest-level
+env mutation plus a ``jax.config`` override (the machine's sitecustomize force-
+registers a TPU platform; the config update wins over it).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs

@@ -1,0 +1,67 @@
+"""Init/rank/topology-installation tests (mirrors the reference's
+``test/torch_basics_test.py`` — SURVEY.md §4)."""
+
+import networkx as nx
+import numpy as np
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import topology_util as tu
+
+
+@pytest.fixture(autouse=True)
+def fresh_context(devices):
+    bf.init(local_size=2)
+    yield
+    bf.shutdown()
+
+
+def test_init_size_rank():
+    assert bf.is_initialized()
+    assert bf.size() == 8
+    assert bf.local_size() == 2
+    assert bf.machine_size() == 4
+    assert bf.rank() == 0  # single controller owns rank 0
+    assert bf.local_rank() == 0
+    assert bf.machine_rank() == 0
+
+
+def test_default_topology_is_exp2():
+    topo = bf.load_topology()
+    assert tu.IsTopologyEquivalent(topo, tu.ExponentialTwoGraph(8))
+    assert not bf.is_topo_weighted()
+
+
+def test_set_topology_and_neighbors():
+    changed = bf.set_topology(tu.RingGraph(8))
+    assert changed
+    assert not bf.set_topology(tu.RingGraph(8))  # identical -> no-op
+    assert bf.in_neighbor_ranks(0) == [1, 7]
+    assert bf.out_neighbor_ranks(0) == [1, 7]
+    bf.set_topology(tu.RingGraph(8, connect_style=1))
+    assert bf.in_neighbor_ranks(3) == [2]
+    assert bf.out_neighbor_ranks(3) == [4]
+
+
+def test_set_topology_wrong_size_raises():
+    with pytest.raises(ValueError):
+        bf.set_topology(tu.RingGraph(4))
+
+
+def test_machine_topology():
+    assert bf.load_machine_topology() is not None
+    bf.set_machine_topology(tu.RingGraph(4))
+    assert bf.in_neighbor_machine_ranks(0) == [1, 3]
+    with pytest.raises(ValueError):
+        bf.set_machine_topology(tu.RingGraph(3))
+
+
+def test_weighted_flag():
+    bf.set_topology(tu.MeshGrid2DGraph(8))
+    assert bf.is_topo_weighted()
+    bf.set_topology(tu.ExponentialTwoGraph(8))
+    assert not bf.is_topo_weighted()
+
+
+def test_window_model_supported():
+    assert bf.unified_mpi_window_model_supported()

@@ -1,0 +1,206 @@
+"""Collective-op correctness on the 8-device mesh (mirrors the reference's
+``test/torch_ops_test.py`` — SURVEY.md §4: every collective x dtype x
+static/dynamic topology against analytically-known results)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import topology_util as tu
+
+SIZE = 8
+
+
+@pytest.fixture(autouse=True)
+def fresh_context(devices):
+    bf.init(local_size=2)
+    yield
+    bf.shutdown()
+
+
+def rank_tensor(shape=(4,), dtype=jnp.float32):
+    """Rank-major tensor whose rank-r slice is filled with the value r —
+    the reference tests' standard fixture."""
+    r = jnp.arange(SIZE, dtype=dtype).reshape((SIZE,) + (1,) * len(shape))
+    return jnp.broadcast_to(r, (SIZE,) + shape)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.float64, jnp.int32, jnp.bfloat16])
+def test_allreduce_average(dtype):
+    x = rank_tensor((3, 2), dtype)
+    out = bf.allreduce(x, average=True)
+    expected = (SIZE - 1) / 2.0
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float64), expected, atol=1e-2
+    )
+
+
+def test_allreduce_sum():
+    x = rank_tensor((5,))
+    out = bf.allreduce(x, average=False)
+    np.testing.assert_allclose(np.asarray(out), SIZE * (SIZE - 1) / 2)
+
+
+@pytest.mark.parametrize("root", [0, 3, 7])
+def test_broadcast(root):
+    x = rank_tensor((4,))
+    out = bf.broadcast(x, root_rank=root)
+    np.testing.assert_allclose(np.asarray(out), root)
+
+
+def test_allgather():
+    x = rank_tensor((2, 3))
+    out = bf.allgather(x)
+    assert out.shape == (SIZE, SIZE * 2, 3)
+    for r in range(SIZE):
+        for s in range(SIZE):
+            np.testing.assert_allclose(np.asarray(out[r, 2 * s : 2 * s + 2]), s)
+
+
+def _expected_gossip(W, x):
+    """x rank-major [size, ...] -> W @ x along the rank axis."""
+    flat = np.asarray(x, dtype=np.float64).reshape(W.shape[0], -1)
+    return (W @ flat).reshape(np.asarray(x).shape)
+
+
+TOPOS = {
+    "exp2": lambda: tu.ExponentialTwoGraph(SIZE),
+    "ring": lambda: tu.RingGraph(SIZE),
+    "ring_uni": lambda: tu.RingGraph(SIZE, connect_style=1),
+    "mesh2d": lambda: tu.MeshGrid2DGraph(SIZE),
+    "star": lambda: tu.StarGraph(SIZE),
+    "full": lambda: tu.FullyConnectedGraph(SIZE),
+}
+
+
+@pytest.mark.parametrize("name", sorted(TOPOS))
+def test_neighbor_allreduce_static(name):
+    topo = TOPOS[name]()
+    bf.set_topology(topo)
+    x = rank_tensor((3,))
+    out = bf.neighbor_allreduce(x)
+    expected = _expected_gossip(tu.GetWeightMatrix(topo), x)
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5)
+
+
+def test_neighbor_allreduce_full_graph_equals_allreduce():
+    bf.set_topology(tu.FullyConnectedGraph(SIZE))
+    x = rank_tensor((4,))
+    gossip = bf.neighbor_allreduce(x)
+    ar = bf.allreduce(x, average=True)
+    np.testing.assert_allclose(np.asarray(gossip), np.asarray(ar), rtol=1e-5)
+
+
+def test_neighbor_allreduce_preserves_average():
+    """Doubly-stochastic mixing must keep the global mean invariant —
+    the convergence invariant of decentralized averaging."""
+    bf.set_topology(tu.ExponentialTwoGraph(SIZE))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(SIZE, 6)))
+    mean0 = np.asarray(x).mean(axis=0)
+    out = x
+    for _ in range(5):
+        out = bf.neighbor_allreduce(out)
+    np.testing.assert_allclose(np.asarray(out).mean(axis=0), mean0, rtol=1e-6)
+    # and it actually contracts toward consensus
+    assert np.asarray(out).std(axis=0).max() < np.asarray(x).std(axis=0).max() * 0.2
+
+
+def test_neighbor_allreduce_dynamic_src():
+    """One-peer dynamic ring: every rank averages with its left neighbor."""
+    src_weights = [{(r - 1) % SIZE: 0.5} for r in range(SIZE)]
+    x = rank_tensor((2,))
+    out = bf.neighbor_allreduce(x, self_weight=0.5, src_weights=src_weights)
+    expected = np.array([0.5 * r + 0.5 * ((r - 1) % SIZE) for r in range(SIZE)])
+    np.testing.assert_allclose(np.asarray(out)[:, 0], expected, rtol=1e-6)
+
+
+def test_neighbor_allreduce_dynamic_dst():
+    """dst_weights at the sender: rank r sends 0.5*x to (r+1)."""
+    dst_weights = [{(r + 1) % SIZE: 0.5} for r in range(SIZE)]
+    x = rank_tensor((2,))
+    out = bf.neighbor_allreduce(x, self_weight=0.5, dst_weights=dst_weights)
+    expected = np.array([0.5 * r + 0.5 * ((r - 1) % SIZE) for r in range(SIZE)])
+    np.testing.assert_allclose(np.asarray(out)[:, 0], expected, rtol=1e-6)
+
+
+def test_neighbor_allreduce_dynamic_rotation_matches_one_peer_generator():
+    gens = [tu.GetDynamicOnePeerSendRecvRanks(SIZE, r) for r in range(SIZE)]
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(SIZE, 4)))
+    mean0 = np.asarray(x).mean(axis=0)
+    out = x
+    for _ in range(3):
+        per_rank = [next(g) for g in gens]
+        src_weights = [{p[1][0]: 0.5} for p in per_rank]
+        out = bf.neighbor_allreduce(out, self_weight=0.5, src_weights=src_weights)
+    np.testing.assert_allclose(np.asarray(out).mean(axis=0), mean0, rtol=1e-6)
+
+
+def test_neighbor_allgather_regular():
+    bf.set_topology(tu.RingGraph(SIZE))
+    x = rank_tensor((2,))
+    out = bf.neighbor_allgather(x)
+    assert out.shape == (SIZE, 4)  # 2 neighbors x 2 elements
+    for r in range(SIZE):
+        nbrs = sorted([(r - 1) % SIZE, (r + 1) % SIZE])
+        np.testing.assert_allclose(np.asarray(out[r]), np.repeat(nbrs, 2))
+
+
+def test_neighbor_allgather_irregular_padded():
+    bf.set_topology(tu.StarGraph(SIZE))
+    x = rank_tensor((2,))
+    out = bf.neighbor_allgather(x)
+    # irregular: padded [size, maxD, 2]; center has 7 neighbors, leaves 1
+    assert out.shape == (SIZE, SIZE - 1, 2)
+    np.testing.assert_allclose(np.asarray(out[0, :, 0]), np.arange(1, SIZE))
+    for r in range(1, SIZE):
+        np.testing.assert_allclose(np.asarray(out[r, 0]), 0.0)  # center value
+        np.testing.assert_allclose(np.asarray(out[r, 1:]), 0.0)  # padding
+
+
+def test_hierarchical_neighbor_allreduce():
+    # 4 machines x 2 local; machine ring topology
+    bf.set_machine_topology(tu.RingGraph(4))
+    x = rank_tensor((3,))
+    out = bf.hierarchical_neighbor_allreduce(x)
+    # local averages: machine m has ranks 2m, 2m+1 -> avg = 2m + 0.5
+    local_avg = np.array([2 * m + 0.5 for m in range(4)])
+    W = tu.GetWeightMatrix(tu.RingGraph(4))
+    machine_out = W @ local_avg
+    expected = np.repeat(machine_out, 2)
+    np.testing.assert_allclose(np.asarray(out)[:, 0], expected, rtol=1e-5)
+
+
+def test_nonblocking_and_handles():
+    x = rank_tensor((4,))
+    h = bf.neighbor_allreduce_nonblocking(x)
+    out = bf.synchronize(h)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(bf.neighbor_allreduce(x)), rtol=1e-6)
+    assert bf.poll(h) in (True, False)
+    h2 = bf.allreduce_nonblocking(x)
+    np.testing.assert_allclose(np.asarray(bf.wait(h2)), np.asarray(bf.allreduce(x)), rtol=1e-6)
+
+
+def test_barrier_runs():
+    bf.barrier()
+
+
+def test_int_dtype_neighbor_allreduce_promotes():
+    bf.set_topology(tu.RingGraph(SIZE))
+    x = rank_tensor((2,), jnp.int32)
+    out = bf.neighbor_allreduce(x)
+    assert jnp.issubdtype(out.dtype, jnp.floating)
+
+
+def test_neighbor_allreduce_per_rank_self_weight_static():
+    """Docstring-promised form: per-rank self_weight sequence with the
+    installed (static) topology."""
+    bf.set_topology(tu.RingGraph(SIZE))
+    x = rank_tensor((2,))
+    sw = [0.5] * SIZE
+    out = bf.neighbor_allreduce(x, self_weight=sw)
+    W = tu.GetWeightMatrix(tu.RingGraph(SIZE))
+    np.fill_diagonal(W, 0.5)
+    expected = _expected_gossip(W, x)
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5)

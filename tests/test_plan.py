@@ -1,0 +1,102 @@
+"""CommPlan compiler unit tests: the shift-class decomposition must exactly
+reproduce the topology's mixing matrix."""
+
+import numpy as np
+import pytest
+
+from bluefog_tpu import topology_util as tu
+from bluefog_tpu.core.plan import compile_plan, plan_from_neighbor_lists
+
+
+TOPOS = {
+    "exp2_8": lambda: tu.ExponentialTwoGraph(8),
+    "exp2_6": lambda: tu.ExponentialTwoGraph(6),
+    "ring_8": lambda: tu.RingGraph(8),
+    "ring_uni": lambda: tu.RingGraph(8, connect_style=1),
+    "mesh_8": lambda: tu.MeshGrid2DGraph(8),
+    "star_8": lambda: tu.StarGraph(8),
+    "full_8": lambda: tu.FullyConnectedGraph(8),
+    "symexp_8": lambda: tu.SymmetricExponentialGraph(8, base=2),
+}
+
+
+@pytest.mark.parametrize("name", sorted(TOPOS))
+def test_plan_reproduces_mixing_matrix(name):
+    topo = TOPOS[name]()
+    plan = compile_plan(topo)
+    W_ref = tu.GetWeightMatrix(topo)
+    np.testing.assert_allclose(plan.mixing_matrix(), W_ref, atol=1e-12)
+
+
+@pytest.mark.parametrize("name", sorted(TOPOS))
+def test_classes_are_valid_partial_permutations(name):
+    plan = compile_plan(TOPOS[name]())
+    for cls in plan.classes:
+        srcs = [s for s, _ in cls.perm]
+        dsts = [d for _, d in cls.perm]
+        assert len(set(srcs)) == len(srcs)
+        assert len(set(dsts)) == len(dsts)
+        # shift classes are uniform rotations
+        assert cls.shift is not None
+
+
+def test_class_count_is_degree_for_circulant():
+    plan = compile_plan(tu.ExponentialTwoGraph(8))
+    assert len(plan.classes) == 3  # offsets 1, 2, 4 — the minimum possible
+    plan = compile_plan(tu.RingGraph(8))
+    assert len(plan.classes) == 2
+
+
+def test_slot_indices_match_sorted_in_neighbors():
+    plan = compile_plan(tu.ExponentialTwoGraph(8))
+    for cls in plan.classes:
+        for s, d in cls.perm:
+            assert plan.in_neighbors[d][cls.slot_index[d]] == s
+
+
+def test_plan_from_neighbor_lists_uniform():
+    size = 8
+    srcs = [[(r - 1) % size] for r in range(size)]
+    plan = plan_from_neighbor_lists(size, srcs)
+    W = plan.mixing_matrix()
+    np.testing.assert_allclose(W.sum(axis=1), 1.0, atol=1e-12)
+    for r in range(size):
+        assert W[r, (r - 1) % size] == pytest.approx(0.5)
+        assert W[r, r] == pytest.approx(0.5)
+
+
+def test_plan_from_neighbor_lists_weighted():
+    size = 4
+    srcs = [[1, 2], [0], [], [0, 1, 2]]
+    w = [{1: 0.2, 2: 0.3}, {0: 0.5}, {}, {0: 0.1, 1: 0.1, 2: 0.1}]
+    plan = plan_from_neighbor_lists(size, srcs, src_weights=w)
+    W = plan.mixing_matrix()
+    assert W[0, 1] == pytest.approx(0.2)
+    assert W[0, 0] == pytest.approx(0.5)
+    assert W[2, 2] == pytest.approx(1.0)
+    np.testing.assert_allclose(W.sum(axis=1), 1.0, atol=1e-12)
+
+
+def test_plan_rejects_bad_input():
+    with pytest.raises(ValueError):
+        plan_from_neighbor_lists(4, [[0], [], [], []])  # self-edge
+    with pytest.raises(ValueError):
+        plan_from_neighbor_lists(4, [[9], [], [], []])
+    with pytest.raises(ValueError):
+        plan_from_neighbor_lists(4, [[1, 1], [], [], []])
+
+
+def test_self_loop_folds_into_self_weight():
+    import networkx as nx
+
+    G = tu.RingGraph(4)
+    G.add_edge(2, 2, weight=0.2)
+    plan = compile_plan(G)
+    W = plan.mixing_matrix()
+    np.testing.assert_allclose(W.sum(axis=1), 1.0, atol=1e-12)
+
+
+def test_per_rank_self_weight_override():
+    sw = (0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8)
+    plan = compile_plan(tu.RingGraph(8), self_weight=sw)
+    assert plan.self_weights == sw

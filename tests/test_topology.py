@@ -1,0 +1,151 @@
+"""Topology-library property tests (mirrors the reference's
+``test/topology_util_test.py`` strategy — SURVEY.md §4: pure-Python graph
+constructor properties, no devices needed)."""
+
+import math
+
+import networkx as nx
+import numpy as np
+import pytest
+
+from bluefog_tpu import topology_util as tu
+
+
+ALL_SIZES = [1, 2, 3, 4, 5, 7, 8, 12, 16]
+
+
+def _row_stochastic(topo):
+    W = tu.GetWeightMatrix(topo)
+    np.testing.assert_allclose(W.sum(axis=1), 1.0, atol=1e-12)
+    assert (W >= -1e-12).all()
+    return W
+
+
+@pytest.mark.parametrize("size", ALL_SIZES)
+def test_exponential_two_graph(size):
+    G = tu.ExponentialTwoGraph(size)
+    assert G.number_of_nodes() == size
+    W = _row_stochastic(G)
+    # doubly stochastic for circulant graphs
+    np.testing.assert_allclose(W.sum(axis=0), 1.0, atol=1e-12)
+    if size > 1:
+        nbits = int(math.ceil(math.log2(size)))
+        expected_deg = len({(1 << j) % size for j in range(nbits)} - {0})
+        assert all(d == expected_deg for _, d in G.in_degree())
+    assert tu.IsRegularGraph(G)
+
+
+@pytest.mark.parametrize("size", ALL_SIZES)
+def test_ring_graph_styles(size):
+    for style in (0, 1, 2):
+        G = tu.RingGraph(size, connect_style=style)
+        W = _row_stochastic(G)
+        np.testing.assert_allclose(W.sum(axis=0), 1.0, atol=1e-12)
+        if size > 2:
+            expected = 2 if style == 0 else 1
+            assert all(d == expected for _, d in G.in_degree())
+        assert tu.IsRegularGraph(G)
+
+
+@pytest.mark.parametrize("size", ALL_SIZES)
+def test_fully_connected(size):
+    G = tu.FullyConnectedGraph(size)
+    W = _row_stochastic(G)
+    np.testing.assert_allclose(W, np.full((size, size), 1.0 / size), atol=1e-12)
+
+
+@pytest.mark.parametrize("size", [2, 4, 6, 9, 12, 16])
+def test_mesh_grid(size):
+    G = tu.MeshGrid2DGraph(size)
+    W = _row_stochastic(G)
+    # Metropolis-Hastings weights -> symmetric -> doubly stochastic
+    np.testing.assert_allclose(W, W.T, atol=1e-12)
+    np.testing.assert_allclose(W.sum(axis=0), 1.0, atol=1e-12)
+
+
+@pytest.mark.parametrize("size", [2, 3, 5, 8])
+def test_star_graph(size):
+    G = tu.StarGraph(size)
+    W = _row_stochastic(G)
+    np.testing.assert_allclose(W, W.T, atol=1e-12)
+    np.testing.assert_allclose(W.sum(axis=0), 1.0, atol=1e-12)
+    assert not tu.IsRegularGraph(G) or size <= 2
+
+
+@pytest.mark.parametrize("size", [4, 8, 16])
+def test_symmetric_exponential(size):
+    G = tu.SymmetricExponentialGraph(size, base=2)
+    W = _row_stochastic(G)
+    # symmetric offsets => symmetric weight matrix
+    np.testing.assert_allclose(W, W.T, atol=1e-12)
+
+
+def test_equivalence():
+    assert tu.IsTopologyEquivalent(tu.RingGraph(8), tu.RingGraph(8))
+    assert not tu.IsTopologyEquivalent(tu.RingGraph(8), tu.ExponentialTwoGraph(8))
+    assert not tu.IsTopologyEquivalent(tu.RingGraph(8), tu.RingGraph(8, connect_style=1))
+
+
+def test_recv_send_weights_consistency():
+    G = tu.ExponentialTwoGraph(8)
+    for r in range(8):
+        sw, recv = tu.GetRecvWeights(G, r)
+        assert sw > 0
+        assert set(recv) == set(G.predecessors(r))
+        sws, send = tu.GetSendWeights(G, r)
+        assert set(send) == set(G.successors(r))
+
+
+def test_dynamic_one_peer_covers_all_offsets():
+    size = 8
+    gens = [tu.GetDynamicOnePeerSendRecvRanks(size, r) for r in range(size)]
+    seen_offsets = set()
+    for t in range(6):
+        per_rank = [next(g) for g in gens]
+        # each step must be a permutation: every rank sends to exactly one
+        # distinct destination and receives from exactly one source
+        dsts = [p[0][0] for p in per_rank]
+        srcs = [p[1][0] for p in per_rank]
+        assert sorted(dsts) == list(range(size))
+        assert sorted(srcs) == list(range(size))
+        # consistency: r sends to d  <=>  d receives from r
+        for r, p in enumerate(per_rank):
+            assert per_rank[p[0][0]][1] == [r]
+        seen_offsets.add((dsts[0] - 0) % size)
+    assert seen_offsets == {1, 2, 4}
+
+
+def test_inner_outer_ring_dynamic():
+    world, local = 8, 2
+    gens = [tu.GetInnerOuterRingDynamicSendRecvRanks(world, local, r) for r in range(world)]
+    for t in range(4):
+        per_rank = [next(g) for g in gens]
+        dsts = [p[0][0] for p in per_rank]
+        assert sorted(dsts) == list(range(world))
+        if t % 2 == 0:
+            # inner step stays within the machine
+            for r, p in enumerate(per_rank):
+                assert p[0][0] // local == r // local
+        else:
+            for r, p in enumerate(per_rank):
+                assert p[0][0] % local == r % local
+                assert p[0][0] // local != r // local
+
+
+def test_infer_helpers_roundtrip():
+    size = 8
+    G = tu.ExponentialTwoGraph(size)
+    srcs = [sorted(G.predecessors(r)) for r in range(size)]
+    dsts = tu.InferDestinationFromSourceRanks(srcs)
+    back = tu.InferSourceFromDestinationRanks(dsts)
+    assert back == [sorted(s) for s in srcs]
+
+
+def test_machine_exp2_dynamic():
+    world, local = 8, 2
+    g0 = tu.GetExp2DynamicSendRecvMachineRanks(world, local, 0, 0)
+    g1 = tu.GetExp2DynamicSendRecvMachineRanks(world, local, 1, 1)
+    s, r = next(g0)
+    assert s and r  # machine-level neighbors for local_rank 0
+    s1, r1 = next(g1)
+    assert s1 == [] and r1 == []  # non-zero local rank sits out

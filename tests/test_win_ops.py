@@ -1,0 +1,195 @@
+"""Window-op semantics tests (mirrors the reference's
+``test/torch_win_ops_test.py`` — SURVEY.md §4: create/put/get/accumulate/
+update/mutex semantics + multi-step convergence-to-consensus with
+tolerances)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import topology_util as tu
+
+SIZE = 8
+
+
+@pytest.fixture(autouse=True)
+def fresh_context(devices):
+    bf.init(local_size=2)
+    yield
+    bf.win_free()
+    bf.turn_off_win_ops_with_associated_p()
+    bf.shutdown()
+
+
+def rank_tensor(shape=(4,)):
+    r = jnp.arange(SIZE, dtype=jnp.float32).reshape((SIZE,) + (1,) * len(shape))
+    return jnp.broadcast_to(r, (SIZE,) + shape)
+
+
+def test_win_create_free():
+    x = rank_tensor()
+    assert bf.win_create(x, "w1")
+    assert not bf.win_create(x, "w1")  # duplicate
+    assert bf.win_free("w1")
+    assert not bf.win_free("w1")
+
+
+def test_win_create_requires_rank_major():
+    with pytest.raises(ValueError):
+        bf.win_create(jnp.zeros((3, 2)), "bad")
+
+
+def test_win_update_before_put_is_identity_average():
+    """Buffers initialize to the local tensor, so the first win_update is a
+    weighted average of identical values == the original tensor."""
+    bf.set_topology(tu.RingGraph(SIZE))
+    x = rank_tensor()
+    bf.win_create(x, "w")
+    out = bf.win_update("w")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
+
+
+def test_win_put_then_update_is_gossip_step():
+    bf.set_topology(tu.RingGraph(SIZE))
+    topo = bf.load_topology()
+    x = rank_tensor()
+    bf.win_create(x, "w")
+    bf.win_put(x, "w")
+    out = bf.win_update("w")
+    W = tu.GetWeightMatrix(topo)
+    expected = (W @ np.asarray(x).reshape(SIZE, -1)).reshape(np.asarray(x).shape)
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5)
+
+
+def test_win_put_with_dst_weights():
+    bf.set_topology(tu.RingGraph(SIZE, connect_style=1))
+    x = rank_tensor()
+    bf.win_create(x, "w", zero_init=True)
+    dst = [{(r + 1) % SIZE: 2.0} for r in range(SIZE)]
+    bf.win_put(x, "w", dst_weights=dst)
+    # rank r's single mailbox slot now holds 2*(r-1)
+    out = bf.win_update("w", self_weight=0.0, neighbor_weights=[
+        {(r - 1) % SIZE: 1.0} for r in range(SIZE)
+    ])
+    expected = np.array([2.0 * ((r - 1) % SIZE) for r in range(SIZE)])
+    np.testing.assert_allclose(np.asarray(out)[:, 0], expected, rtol=1e-6)
+
+
+def test_win_accumulate():
+    bf.set_topology(tu.RingGraph(SIZE, connect_style=1))
+    x = jnp.ones((SIZE, 2))
+    bf.win_create(x, "w", zero_init=True)
+    bf.win_accumulate(x, "w")
+    bf.win_accumulate(x, "w")
+    out = bf.win_update("w", self_weight=0.0,
+                        neighbor_weights=[{(r - 1) % SIZE: 1.0} for r in range(SIZE)],
+                        reset=True)
+    np.testing.assert_allclose(np.asarray(out), 2.0, rtol=1e-6)
+    # reset zeroed the mailbox
+    out2 = bf.win_update("w", self_weight=0.0,
+                         neighbor_weights=[{(r - 1) % SIZE: 1.0} for r in range(SIZE)])
+    np.testing.assert_allclose(np.asarray(out2), 0.0, atol=1e-6)
+
+
+def test_win_get():
+    bf.set_topology(tu.RingGraph(SIZE, connect_style=1))
+    x = rank_tensor()
+    bf.win_create(x, "w", zero_init=True)
+    bf.win_get("w")
+    out = bf.win_update("w", self_weight=0.0,
+                        neighbor_weights=[{(r - 1) % SIZE: 1.0} for r in range(SIZE)])
+    expected = np.array([(r - 1) % SIZE for r in range(SIZE)], dtype=np.float64)
+    np.testing.assert_allclose(np.asarray(out)[:, 0], expected, rtol=1e-6)
+
+
+def test_win_version_tracking():
+    bf.set_topology(tu.RingGraph(SIZE))
+    x = rank_tensor()
+    bf.win_create(x, "w")
+    v0 = bf.get_win_version("w")
+    assert all(all(c == 0 for c in d.values()) for d in v0)
+    bf.win_put(x, "w")
+    bf.win_put(x, "w")
+    v2 = bf.get_win_version("w")
+    assert all(all(c == 2 for c in d.values()) for d in v2)
+
+
+def test_win_mutex_noop():
+    x = rank_tensor()
+    bf.win_create(x, "w")
+    with bf.win_mutex("w"):
+        bf.win_put(x, "w")
+
+
+def test_gossip_consensus_convergence():
+    """Repeated put/update converges every rank to the global mean — the
+    reference's bounded-disagreement consensus assertion (SURVEY.md §4)."""
+    bf.set_topology(tu.ExponentialTwoGraph(SIZE))
+    rng = np.random.default_rng(42)
+    x = jnp.asarray(rng.normal(size=(SIZE, 5)).astype(np.float32))
+    mean0 = np.asarray(x).mean(axis=0)
+    bf.win_create(x, "w")
+    cur = x
+    for _ in range(25):
+        bf.win_put(cur, "w")
+        cur = bf.win_update("w")
+    np.testing.assert_allclose(np.asarray(cur), np.tile(mean0, (SIZE, 1)), atol=1e-3)
+
+
+def test_push_sum_with_associated_p():
+    """Push-sum on a directed ring (column-stochastic sends, x/p debias):
+    the classic asymmetric-topology average that plain gossip cannot do."""
+    bf.turn_on_win_ops_with_associated_p()
+    bf.set_topology(tu.RingGraph(SIZE, connect_style=1))
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(SIZE, 3)).astype(np.float32))
+    mean0 = np.asarray(x).mean(axis=0)
+    bf.win_create(x, "w", zero_init=True)
+    cur = x
+    # column-stochastic step: keep 1/2, send 1/2 to the single out-neighbor;
+    # the associated p follows the exact same dynamics and debiases the
+    # non-doubly-stochastic mixing.
+    dst = [{(r + 1) % SIZE: 0.5} for r in range(SIZE)]
+    ones_prev = [{(r - 1) % SIZE: 1.0} for r in range(SIZE)]
+    for _ in range(60):
+        bf.win_accumulate(cur, "w", dst_weights=dst)
+        cur = bf.win_update("w", self_weight=0.5, neighbor_weights=ones_prev, reset=True)
+    p = np.asarray(bf.win_associated_p("w"))
+    np.testing.assert_allclose(p.sum(), SIZE, rtol=1e-5)  # mass conservation
+    debiased = np.asarray(cur) / p[:, None]
+    np.testing.assert_allclose(debiased, np.tile(mean0, (SIZE, 1)), atol=1e-2)
+
+
+def test_selective_win_put_touches_only_listed_ranks():
+    """A put with dst_weights listing one neighbor must leave every other
+    mailbox slot (and version counter) untouched."""
+    bf.set_topology(tu.RingGraph(SIZE))
+    x = rank_tensor()
+    bf.win_create(x, "w", zero_init=True)
+    # only rank 0 puts, and only to rank 1
+    dst = [{1: 1.0}] + [{} for _ in range(SIZE - 1)]
+    bf.win_put(x, "w", dst_weights=dst)
+    ver = bf.get_win_version("w")
+    assert ver[1] == {0: 1, 2: 0}
+    for r in [0] + list(range(2, SIZE)):
+        assert all(c == 0 for c in ver[r].values()), (r, ver[r])
+    out = bf.win_update("w", self_weight=0.0,
+                        neighbor_weights=[{s: 1.0 for s in tu.GetRecvWeights(bf.load_topology(), r)[1]} for r in range(SIZE)])
+    expected = np.zeros((SIZE,))
+    expected[1] = 0.0  # rank 1 got rank0's value 0.0
+    np.testing.assert_allclose(np.asarray(out)[:, 0], expected, atol=1e-6)
+
+
+def test_win_put_refreshes_exposure_for_win_get():
+    """put(new) then neighbor get must observe the new value, not the
+    creation-time tensor."""
+    bf.set_topology(tu.RingGraph(SIZE, connect_style=1))
+    x = rank_tensor()
+    bf.win_create(x, "w", zero_init=True)
+    bf.win_put(x + 100.0, "w", dst_weights=[{} for _ in range(SIZE)])  # no deposit
+    bf.win_get("w")
+    out = bf.win_update("w", self_weight=0.0,
+                        neighbor_weights=[{(r - 1) % SIZE: 1.0} for r in range(SIZE)])
+    expected = np.array([(r - 1) % SIZE + 100.0 for r in range(SIZE)])
+    np.testing.assert_allclose(np.asarray(out)[:, 0], expected, rtol=1e-6)
