@@ -1,0 +1,140 @@
+"""Benchmark: ResNet-50 decentralized-SGD throughput, img/sec/chip.
+
+The BASELINE.md north-star metric: decentralized SGD via
+``neighbor_allreduce`` on ``ExponentialTwoGraph`` vs the framework's own
+global-allreduce baseline on identical hardware — ``vs_baseline`` is that
+ratio (target >= 0.90 on multi-chip; the reference numbers were never
+published, so the self-relative ratio is the defined target).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Runs on whatever devices are visible: the real TPU chip under the driver,
+or a virtual CPU mesh for testing (tiny model there so it completes).
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import bluefog_tpu as bf
+from bluefog_tpu import topology_util
+from bluefog_tpu.core import basics
+from bluefog_tpu.models import ResNet18, ResNet50
+from bluefog_tpu.optim import CommunicationType
+from bluefog_tpu.training import make_decentralized_train_step, replicate_for_mesh
+
+
+def build(comm_type, model, mesh, plan, batch, labels, params, batch_stats):
+    init_fn, step_fn = make_decentralized_train_step(
+        model.apply,
+        optax.sgd(0.1, momentum=0.9),
+        mesh,
+        communication_type=comm_type,
+        plan=plan,
+        has_batch_stats=True,
+        donate=False,
+    )
+    opt_state = init_fn(params)
+    return step_fn, opt_state
+
+
+def _sync(loss):
+    """Device-blocking sync via a tiny scalar fetch.
+
+    ``jax.block_until_ready`` does not actually wait on the tunneled TPU
+    platform used by the driver, so synchronization must round-trip a value;
+    a scalar keeps the transfer negligible.
+    """
+    v = float(np.asarray(jnp.sum(loss)))
+    assert np.isfinite(v)
+    return v
+
+
+def time_steps(step_fn, params, batch_stats, opt_state, batch, labels, warmup, iters):
+    loss = None
+    for _ in range(warmup):
+        params, batch_stats, opt_state, loss, _ = step_fn(
+            params, batch_stats, opt_state, batch, labels
+        )
+    _sync(loss)
+    # fetch round-trip latency, subtracted from the timed region below
+    t0 = time.perf_counter()
+    for _ in range(3):
+        _sync(loss)
+    rt = (time.perf_counter() - t0) / 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, batch_stats, opt_state, loss, _ = step_fn(
+            params, batch_stats, opt_state, batch, labels
+        )
+    _sync(loss)
+    dt = time.perf_counter() - t0 - rt
+    return max(dt, 1e-9) / iters
+
+
+def main():
+    platform = jax.devices()[0].platform
+    n = len(jax.devices())
+    on_tpu = platform == "tpu"
+    per_rank_batch = int(os.environ.get("BENCH_BATCH", 64 if on_tpu else 2))
+    iters = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 3))
+    warmup = int(os.environ.get("BENCH_WARMUP", 5 if on_tpu else 1))
+    img = 224 if on_tpu else 16
+    nclass = 1000 if on_tpu else 10
+
+    bf.init()
+    bf.set_topology(topology_util.ExponentialTwoGraph(n))
+    ctx = basics.context()
+
+    if on_tpu:
+        model = ResNet50(num_classes=nclass)
+    else:
+        model = ResNet18(num_classes=nclass, num_filters=8, small_images=True)
+
+    x0 = jnp.ones((per_rank_batch, img, img, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x0, train=True)
+    params = replicate_for_mesh(variables["params"], n)
+    batch_stats = replicate_for_mesh(variables["batch_stats"], n)
+    rng = np.random.default_rng(0)
+    batch = jnp.asarray(
+        rng.normal(size=(n, per_rank_batch, img, img, 3)).astype(np.float32)
+    )
+    labels = jnp.asarray(rng.integers(0, nclass, size=(n, per_rank_batch)), jnp.int32)
+
+    # decentralized (the metric)
+    step_dec, os_dec = build(
+        CommunicationType.neighbor_allreduce, model, ctx.mesh, ctx.plan,
+        batch, labels, params, batch_stats,
+    )
+    t_dec = time_steps(step_dec, params, batch_stats, os_dec, batch, labels, warmup, iters)
+
+    # global-allreduce baseline (the reference point)
+    step_ar, os_ar = build(
+        CommunicationType.allreduce, model, ctx.mesh, None,
+        batch, labels, params, batch_stats,
+    )
+    t_ar = time_steps(step_ar, params, batch_stats, os_ar, batch, labels, warmup, iters)
+
+    imgs_per_sec_chip = per_rank_batch / t_dec  # per-rank == per-chip
+    ratio = t_ar / t_dec  # >1 means gossip step is faster than allreduce
+    print(
+        json.dumps(
+            {
+                "metric": "ResNet-50 images/sec/chip (neighbor_allreduce exp2)"
+                if on_tpu
+                else "ResNet-18-tiny images/sec/chip (neighbor_allreduce exp2, CPU)",
+                "value": round(imgs_per_sec_chip, 2),
+                "unit": "img/s/chip",
+                "vs_baseline": round(ratio, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

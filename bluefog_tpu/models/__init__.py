@@ -1,0 +1,4 @@
+from bluefog_tpu.models.lenet import LeNet5
+from bluefog_tpu.models.resnet import ResNet, ResNet18, ResNet50
+
+__all__ = ["LeNet5", "ResNet", "ResNet18", "ResNet50"]

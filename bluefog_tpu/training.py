@@ -1,0 +1,175 @@
+"""Decentralized SPMD train-step builder — the idiomatic TPU path.
+
+This is the flagship composition the whole framework exists for (SURVEY.md
+§7 stage 3/6): a single jitted SPMD program in which every rank computes its
+local forward/backward on its batch shard and the decentralized optimizer's
+gossip (``ppermute`` rounds) is scheduled by XLA *inside* the step —
+overlapping communication with compute exactly where the reference relied on
+its background thread + per-parameter hooks (SURVEY.md §3.3).
+
+Works on any mesh: flat ``(bf_nodes,)`` for rank-level gossip, factored
+``(bf_machines, bf_local)`` for hierarchical.  BatchNorm state stays local
+per rank (data-parallel semantics, like the reference); only parameters are
+communicated.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bluefog_tpu.core.basics import LOCAL_AXIS, MACHINES_AXIS, NODES_AXIS
+from bluefog_tpu.core.plan import CommPlan
+from bluefog_tpu.optim import (
+    CommunicationType,
+    adapt_then_combine_spmd,
+    adapt_with_combine_spmd,
+    gradient_allreduce_spmd,
+    make_spmd_comm_fn,
+)
+
+__all__ = ["make_decentralized_train_step", "replicate_for_mesh"]
+
+
+def softmax_cross_entropy(logits, labels):
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def make_decentralized_train_step(
+    apply_fn: Callable,
+    base_optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    communication_type: CommunicationType = CommunicationType.neighbor_allreduce,
+    plan: Optional[CommPlan] = None,
+    machine_plan: Optional[CommPlan] = None,
+    mode: str = "atc",
+    loss_fn: Callable = softmax_cross_entropy,
+    has_batch_stats: bool = False,
+    num_steps_per_communication: int = 1,
+    donate: bool = True,
+):
+    """Build ``(init_fn, step_fn)`` for decentralized training on ``mesh``.
+
+    Data layout: every array is *rank-major sharded* — params/opt_state/
+    batch leading axis is the global rank axis.  ``step_fn(train_state,
+    batch, labels) -> (train_state, metrics)`` with ``train_state =
+    (params, batch_stats, opt_state)``.
+
+    The returned functions are jit-compiled once per shape; inside, each
+    rank's loss/grad runs on its shard and the optimizer transform carries
+    the gossip.
+    """
+    axes = mesh.axis_names
+    if set(axes) == {MACHINES_AXIS, LOCAL_AXIS}:
+        spec = P((MACHINES_AXIS, LOCAL_AXIS))
+        axis_name = (MACHINES_AXIS, LOCAL_AXIS)
+    else:
+        spec = P(NODES_AXIS)
+        axis_name = NODES_AXIS
+
+    if communication_type == CommunicationType.allreduce:
+        tx = gradient_allreduce_spmd(
+            base_optimizer, axis_name, num_steps_per_communication
+        )
+    else:
+        comm_fn = make_spmd_comm_fn(communication_type, plan, machine_plan)
+        builder = {"atc": adapt_then_combine_spmd, "awc": adapt_with_combine_spmd}[mode]
+        tx = builder(base_optimizer, comm_fn, num_steps_per_communication)
+
+    def local_step(params, batch_stats, opt_state, batch, labels):
+        # strip the local rank-major axis (length 1 per device)
+        p = jax.tree_util.tree_map(lambda a: a[0], params)
+        bs = jax.tree_util.tree_map(lambda a: a[0], batch_stats)
+        os_ = jax.tree_util.tree_map(
+            lambda a: a[0] if a.ndim >= 1 and a.shape[0] == 1 else a, opt_state
+        )
+        x, y = batch[0], labels[0]
+
+        if has_batch_stats:
+
+            def loss_of(p_):
+                logits, mut = apply_fn(
+                    {"params": p_, "batch_stats": bs}, x, mutable=["batch_stats"]
+                )
+                return loss_fn(logits, y), (logits, mut["batch_stats"])
+
+            (loss, (logits, new_bs)), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(p)
+        else:
+
+            def loss_of(p_):
+                logits = apply_fn({"params": p_}, x)
+                return loss_fn(logits, y), logits
+
+            (loss, logits), grads = jax.value_and_grad(loss_of, has_aux=True)(p)
+            new_bs = bs
+
+        updates, new_os = tx.update(grads, os_, p)
+        new_p = optax.apply_updates(p, updates)
+        acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        # re-attach the rank-major axis
+        expand = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
+        new_os_out = jax.tree_util.tree_map(
+            lambda new, old: new[None] if old.ndim >= 1 and old.shape[0] == 1 else new,
+            new_os,
+            opt_state,
+        )
+        return (
+            expand(new_p),
+            expand(new_bs),
+            new_os_out,
+            expand(loss),
+            expand(acc),
+        )
+
+    def _opt_state_spec(opt_state, example_leaf_count):
+        del example_leaf_count
+        return jax.tree_util.tree_map(
+            lambda a: spec if getattr(a, "ndim", 0) >= 1 else P(), opt_state
+        )
+
+    def init_fn(params, batch_stats=None):
+        """params/batch_stats: rank-major pytrees.  Returns opt_state."""
+        p_local = jax.tree_util.tree_map(lambda a: a[0], params)
+        os_local = tx.init(p_local)
+        n = mesh.devices.size
+        # broadcast rank-major leaves across ranks; scalars replicated
+        return jax.tree_util.tree_map(
+            lambda a: jnp.broadcast_to(a[None], (n,) + a.shape)
+            if getattr(a, "ndim", 0) >= 1
+            else a,
+            os_local,
+        )
+
+    compiled = {}
+
+    def step_fn(params, batch_stats, opt_state, batch, labels):
+        key = jax.tree_util.tree_structure(opt_state)
+        if key not in compiled:
+            os_spec = _opt_state_spec(opt_state, None)
+            compiled[key] = jax.jit(
+                jax.shard_map(
+                    local_step,
+                    mesh=mesh,
+                    in_specs=(spec, spec, os_spec, spec, spec),
+                    out_specs=(spec, spec, os_spec, spec, spec),
+                ),
+                donate_argnums=(0, 1, 2) if donate else (),
+            )
+        return compiled[key](params, batch_stats, opt_state, batch, labels)
+
+    return init_fn, step_fn
+
+
+def replicate_for_mesh(tree, n: int):
+    """Replicate a single-rank pytree into rank-major layout [n, ...]."""
+    return jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(a[None], (n,) + a.shape), tree
+    )

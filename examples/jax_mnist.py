@@ -1,0 +1,157 @@
+"""LeNet/MNIST decentralized training — JAX twin of the reference's
+``examples/pytorch_mnist.py`` [U] (the driver's tracked config #1,
+BASELINE.md).
+
+Each rank holds a private shard of the dataset; parameters start broadcast
+from rank 0 (``bf.broadcast_parameters``, as upstream) and are gossiped by
+the chosen distributed optimizer each step.
+
+The environment has no network access, so when the MNIST arrays are not on
+disk a structured synthetic stand-in (class-dependent blob patterns, same
+shapes/dtypes) is generated — accuracy dynamics remain meaningful.
+
+Run (CPU, 8 virtual ranks):
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/jax_mnist.py --epochs 2
+"""
+
+import argparse
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import bluefog_tpu as bf
+from bluefog_tpu import topology_util
+from bluefog_tpu.models import LeNet5
+from bluefog_tpu.optim import CommunicationType
+
+
+def load_mnist(n_train=2048, n_test=512, rng=None):
+    """Real MNIST if present at $MNIST_NPZ, else structured synthetic."""
+    path = os.environ.get("MNIST_NPZ", "/data/mnist.npz")
+    if os.path.exists(path):
+        d = np.load(path)
+        return (
+            d["x_train"][:n_train, ..., None] / 255.0,
+            d["y_train"][:n_train],
+            d["x_test"][:n_test, ..., None] / 255.0,
+            d["y_test"][:n_test],
+        )
+    rng = rng or np.random.default_rng(0)
+    # synthetic: each class is a distinct smoothed random template + noise
+    templates = rng.normal(size=(10, 28, 28)).astype(np.float32)
+    for _ in range(2):  # cheap smoothing
+        templates = (
+            templates
+            + np.roll(templates, 1, 1)
+            + np.roll(templates, -1, 1)
+            + np.roll(templates, 1, 2)
+            + np.roll(templates, -1, 2)
+        ) / 5.0
+
+    def make(n):
+        y = rng.integers(0, 10, size=n)
+        x = templates[y] + 0.5 * rng.normal(size=(n, 28, 28)).astype(np.float32)
+        return x[..., None].astype(np.float32), y.astype(np.int32)
+
+    xtr, ytr = make(n_train)
+    xte, yte = make(n_test)
+    return xtr, ytr, xte, yte
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--batch-size", type=int, default=16, help="per rank")
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument(
+        "--mode",
+        default="neighbor_allreduce",
+        choices=["neighbor_allreduce", "allreduce", "hierarchical", "empty"],
+    )
+    args = parser.parse_args()
+
+    bf.init()
+    n = bf.size()
+    bf.set_topology(topology_util.ExponentialTwoGraph(n))
+
+    xtr, ytr, xte, yte = load_mnist()
+    per_rank = len(xtr) // n
+    xtr = xtr[: per_rank * n].reshape(n, per_rank, 28, 28, 1)
+    ytr = ytr[: per_rank * n].reshape(n, per_rank)
+
+    model = LeNet5()
+    params0 = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    # rank-major replicate + broadcast from rank 0 for consistent init
+    params = bf.broadcast_parameters(
+        jax.tree_util.tree_map(
+            lambda a: jnp.broadcast_to(a[None], (n,) + a.shape), params0
+        )
+    )
+
+    comm = {
+        "neighbor_allreduce": CommunicationType.neighbor_allreduce,
+        "allreduce": CommunicationType.allreduce,
+        "hierarchical": CommunicationType.hierarchical_neighbor_allreduce,
+        "empty": CommunicationType.empty,
+    }[args.mode]
+    from bluefog_tpu.core import basics
+    from bluefog_tpu.training import make_decentralized_train_step
+
+    ctx = basics.context()
+    mesh = (
+        ctx.hier_mesh
+        if comm == CommunicationType.hierarchical_neighbor_allreduce
+        else ctx.mesh
+    )
+    init_fn, step_fn = make_decentralized_train_step(
+        model.apply,
+        optax.sgd(args.lr, momentum=0.9),
+        mesh,
+        communication_type=comm,
+        plan=ctx.plan if comm == CommunicationType.neighbor_allreduce else None,
+        machine_plan=ctx.machine_plan
+        if comm == CommunicationType.hierarchical_neighbor_allreduce
+        else None,
+        donate=False,
+    )
+    batch_stats = {}  # LeNet has no BatchNorm
+    bs_rank_major = jax.tree_util.tree_map(lambda a: a, batch_stats)
+    state = init_fn(params)
+
+    steps_per_epoch = per_rank // args.batch_size
+    rng = np.random.default_rng(1)
+    for epoch in range(args.epochs):
+        perm = rng.permutation(per_rank)
+        loss = acc_tr = None
+        for s in range(steps_per_epoch):
+            idx = perm[s * args.batch_size : (s + 1) * args.batch_size]
+            bx = jnp.asarray(xtr[:, idx])
+            by = jnp.asarray(ytr[:, idx])
+            params, bs_rank_major, state, loss, acc_tr = step_fn(
+                params, bs_rank_major, state, bx, by
+            )
+        jax.block_until_ready(params)
+        # evaluate rank 0's model on the test set
+        logits = model.apply(
+            {"params": jax.tree_util.tree_map(lambda a: a[0], params)},
+            jnp.asarray(xte),
+        )
+        acc = float(jnp.mean(jnp.argmax(logits, -1) == jnp.asarray(yte)))
+        spread = max(
+            float(np.asarray(l).std(axis=0).max())
+            for l in jax.tree_util.tree_leaves(params)
+        )
+        print(
+            f"epoch {epoch + 1}: test acc (rank0) {acc:.4f}, "
+            f"train loss {float(np.asarray(loss).mean()):.4f}, "
+            f"param consensus spread {spread:.2e}"
+        )
+    bf.shutdown()
+
+
+if __name__ == "__main__":
+    main()
