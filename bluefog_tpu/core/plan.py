@@ -98,37 +98,59 @@ class CommPlan:
         return W
 
 
+def _edge_classes_and_slots(size, edges):
+    """Per-edge (class index, allgather slot).  Uses the native C++ compiler
+    (plan_compiler.cc, sibling of the reference's graph-communicator build
+    [U]) when available; pure-Python fallback otherwise."""
+    try:
+        from bluefog_tpu.native.plan_native import compile_edge_classes
+
+        native = compile_edge_classes(size, edges)
+    except Exception:
+        native = None
+    if native is not None:
+        cls_arr, slot_arr, _ = native
+        return list(cls_arr), list(slot_arr)
+    in_neighbors = [sorted(s for s, d in edges if d == v) for v in range(size)]
+    shifts = sorted({(d - s) % size for s, d in edges})
+    class_of_shift = {sh: i for i, sh in enumerate(shifts)}
+    cls = [class_of_shift[(d - s) % size] for s, d in edges]
+    slot = [in_neighbors[d].index(s) for s, d in edges]
+    return cls, slot
+
+
 def _classes_from_edges(
     size: int,
     edges: Sequence[Tuple[int, int]],
     recv_weight: Dict[Tuple[int, int], float],
 ) -> Tuple[PermClass, ...]:
-    in_neighbors = [sorted(s for s, d in edges if d == v) for v in range(size)]
-    by_shift: Dict[int, list] = {}
-    for s, d in edges:
-        by_shift.setdefault((d - s) % size, []).append((s, d))
-    classes = []
-    for shift in sorted(by_shift):
-        perm = tuple(sorted(by_shift[shift]))
-        rw = [0.0] * size
-        rm = [0] * size
-        sm = [0.0] * size
-        slot = [-1] * size
-        for s, d in perm:
-            rw[d] = recv_weight[(s, d)]
-            rm[d] = 1
-            sm[s] = 1.0
-            slot[d] = in_neighbors[d].index(s)
-        classes.append(
-            PermClass(
-                perm=perm,
-                recv_weights=tuple(rw),
-                recv_mask=tuple(rm),
-                send_mask=tuple(sm),
-                slot_index=tuple(slot),
-            )
+    edges = sorted(edges)
+    if not edges:
+        return ()
+    cls_of, slot_of = _edge_classes_and_slots(size, edges)
+    n_classes = max(cls_of) + 1
+    perm = [[] for _ in range(n_classes)]
+    rw = [[0.0] * size for _ in range(n_classes)]
+    rm = [[0] * size for _ in range(n_classes)]
+    sm = [[0.0] * size for _ in range(n_classes)]
+    slot = [[-1] * size for _ in range(n_classes)]
+    for i, (s, d) in enumerate(edges):
+        c = cls_of[i]
+        perm[c].append((s, d))
+        rw[c][d] = recv_weight[(s, d)]
+        rm[c][d] = 1
+        sm[c][s] = 1.0
+        slot[c][d] = slot_of[i]
+    return tuple(
+        PermClass(
+            perm=tuple(sorted(perm[c])),
+            recv_weights=tuple(rw[c]),
+            recv_mask=tuple(rm[c]),
+            send_mask=tuple(sm[c]),
+            slot_index=tuple(slot[c]),
         )
-    return tuple(classes)
+        for c in range(n_classes)
+    )
 
 
 def compile_plan(

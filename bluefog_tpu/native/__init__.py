@@ -1,0 +1,90 @@
+"""Native (C++) components, loaded via ctypes.
+
+Siblings of the reference's C++ runtime layer (SURVEY.md §2.1).  Everything
+here is optional: each consumer has a pure-Python fallback, and the shared
+library is built on demand from the in-tree sources (`make` in this
+directory) — mirroring the reference's build-on-install extension without
+requiring pybind11 (absent in this environment).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libbluefog_native.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_attempted = False
+
+
+def build(force: bool = False) -> bool:
+    """Compile the shared library in-tree.  Returns True on success."""
+    if os.path.exists(_LIB_PATH) and not force:
+        return True
+    try:
+        subprocess.run(
+            ["make", "-C", _DIR, "-s"],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return os.path.exists(_LIB_PATH)
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded library, building it on first use; None if unavailable."""
+    global _lib, _build_attempted
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            if _build_attempted:
+                return None
+            _build_attempted = True
+            if not build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        # timeline ABI
+        lib.bf_timeline_create.restype = ctypes.c_void_p
+        lib.bf_timeline_create.argtypes = [ctypes.c_char_p]
+        lib.bf_timeline_record.restype = None
+        lib.bf_timeline_record.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_double,
+            ctypes.c_double,
+            ctypes.c_int64,
+        ]
+        lib.bf_timeline_counter.restype = None
+        lib.bf_timeline_counter.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_double,
+            ctypes.c_double,
+        ]
+        lib.bf_timeline_flush.restype = None
+        lib.bf_timeline_flush.argtypes = [ctypes.c_void_p]
+        lib.bf_timeline_destroy.restype = None
+        lib.bf_timeline_destroy.argtypes = [ctypes.c_void_p]
+        # plan compiler ABI
+        lib.bf_plan_compile.restype = ctypes.c_int64
+        lib.bf_plan_compile.argtypes = [
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        _lib = lib
+        return _lib

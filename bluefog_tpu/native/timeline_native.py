@@ -1,0 +1,45 @@
+"""ctypes wrapper over the native timeline writer (timeline.cc)."""
+
+from __future__ import annotations
+
+from bluefog_tpu.native import get_lib
+
+
+class NativeTimelineWriter:
+    """Thread-safe chrome-trace writer with a C++ background flush thread
+    (sibling of the reference's ``TimelineWriter`` [U]).
+
+    Raises RuntimeError if the native library is unavailable — callers
+    (``bluefog_tpu.timeline``) fall back to the pure-Python writer.
+    """
+
+    def __init__(self, path: str):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.bf_timeline_create(path.encode())
+        if not self._h:
+            raise RuntimeError(f"could not create native timeline at {path!r}")
+
+    def record(self, name: str, start_us: float, dur_us: float, tid: int = 0):
+        self._lib.bf_timeline_record(
+            self._h, name.encode(), float(start_us), float(dur_us), int(tid)
+        )
+
+    def counter(self, name: str, ts_us: float, value: float):
+        self._lib.bf_timeline_counter(
+            self._h, name.encode(), float(ts_us), float(value)
+        )
+
+    def flush(self):
+        self._lib.bf_timeline_flush(self._h)
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            try:
+                self._lib.bf_timeline_destroy(h)
+            except Exception:
+                pass
+            self._h = None
