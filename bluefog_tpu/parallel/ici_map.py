@@ -1,0 +1,145 @@
+"""ICI-aware topology layout: map virtual gossip graphs onto the TPU torus.
+
+This module has no sibling in the reference — it is the TPU-native
+replacement for what MPI gave the reference for free: `mpirun` rank
+placement + `MPI_Dist_graph_create_adjacent` letting the MPI implementation
+reorder ranks for the physical network (SURVEY.md §2.4).  On TPU the
+physical network is an ICI torus with wraparound links, and *we* choose the
+rank→chip assignment: a gossip edge between torus-adjacent chips costs one
+hop; a random assignment makes every edge a multi-hop route through other
+chips' routers, eating the bandwidth the gossip win depends on (SURVEY.md
+§7 hard part #3).
+
+Strategy: order devices along a *snake (boustrophedon) Hamiltonian cycle*
+of the torus.  Consecutive snake positions are torus-adjacent, so:
+
+- ``RingGraph`` edges ride exactly one ICI hop each;
+- ``ExponentialTwoGraph``'s 2^k-shift edges stay short: a +s shift along
+  the snake is at most ``ceil(s / X) + min(s mod X, X - s mod X)`` hops on
+  an X-wide torus (row-major snake), i.e. O(s/X) instead of O(s);
+- hop costs are measurable per plan via :func:`plan_hop_cost`, which bench
+  and tests use to compare layouts.
+
+TPU device objects expose physical ``coords`` (x, y, z); on CPU test
+meshes synthetic coords are provided by the caller.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bluefog_tpu.core.plan import CommPlan
+
+Coord = Tuple[int, ...]
+
+__all__ = [
+    "snake_order",
+    "device_coords",
+    "order_devices_for_ring",
+    "hop_distance",
+    "plan_hop_cost",
+    "assignment_from_coords",
+]
+
+
+def snake_order(shape: Sequence[int]) -> List[Coord]:
+    """Boustrophedon visit order of an N-D torus grid.
+
+    Consecutive entries differ by one unit step in exactly one dimension
+    (torus-adjacent); for even leading dimensions the cycle also closes
+    (last adjacent to first via a wraparound link).
+    """
+    shape = tuple(int(s) for s in shape)
+    if not shape:
+        return [()]
+    if len(shape) == 1:
+        return [(i,) for i in range(shape[0])]
+    inner = snake_order(shape[1:])
+    out: List[Coord] = []
+    for i in range(shape[0]):
+        layer = inner if i % 2 == 0 else inner[::-1]
+        out.extend((i,) + c for c in layer)
+    return out
+
+
+def device_coords(devices) -> Optional[List[Coord]]:
+    """Physical coords for TPU devices (None when unavailable, e.g. CPU)."""
+    coords = []
+    for d in devices:
+        c = getattr(d, "coords", None)
+        if c is None:
+            return None
+        coords.append(tuple(int(v) for v in c))
+    return coords
+
+
+def assignment_from_coords(
+    coords: Sequence[Coord], torus_shape: Sequence[int]
+) -> List[int]:
+    """Rank order (device indices) following the snake cycle of the torus.
+
+    ``coords[i]`` is device i's physical coordinate; returns a permutation
+    ``order`` such that rank r should be device ``order[r]``.
+    """
+    pos = {tuple(c): i for i, c in enumerate(coords)}
+    order = []
+    for c in snake_order(torus_shape):
+        if c in pos:
+            order.append(pos[c])
+    if len(order) != len(coords):
+        raise ValueError(
+            f"coords do not tile the torus {tuple(torus_shape)}: "
+            f"{len(order)} of {len(coords)} matched"
+        )
+    return order
+
+
+def order_devices_for_ring(devices, torus_shape: Optional[Sequence[int]] = None):
+    """Reorder ``devices`` so consecutive ranks are torus-adjacent.
+
+    Pass the result to ``bluefog_tpu.init(devices=...)`` before installing a
+    ring/exp-2 topology.  Falls back to the given order when physical coords
+    are unavailable (CPU simulation) — the mapping is then logical only.
+    """
+    coords = device_coords(devices)
+    if coords is None:
+        return list(devices)
+    if torus_shape is None:
+        torus_shape = tuple(max(c[d] for c in coords) + 1 for d in range(len(coords[0])))
+    order = assignment_from_coords(coords, torus_shape)
+    return [devices[i] for i in order]
+
+
+def hop_distance(a: Coord, b: Coord, torus_shape: Sequence[int]) -> int:
+    """Torus Manhattan distance (wraparound-aware) between two coords."""
+    dist = 0
+    for x, y, s in zip(a, b, torus_shape):
+        d = abs(x - y)
+        dist += min(d, s - d)
+    return dist
+
+
+def plan_hop_cost(
+    plan: CommPlan,
+    rank_coords: Sequence[Coord],
+    torus_shape: Sequence[int],
+) -> Dict[str, float]:
+    """Hop statistics of a compiled plan under a rank→coord assignment.
+
+    total_hops drives link-bandwidth use; max_edge_hops is the latency
+    critical path of one gossip round.
+    """
+    hops = [
+        hop_distance(rank_coords[s], rank_coords[d], torus_shape)
+        for cls in plan.classes
+        for s, d in cls.perm
+    ]
+    if not hops:
+        return {"total_hops": 0.0, "max_edge_hops": 0.0, "mean_edge_hops": 0.0}
+    return {
+        "total_hops": float(np.sum(hops)),
+        "max_edge_hops": float(np.max(hops)),
+        "mean_edge_hops": float(np.mean(hops)),
+    }

@@ -1,0 +1,70 @@
+"""ICI layout tests: snake order adjacency, torus distances, and the
+hop-cost advantage of snake placement over naive placement."""
+
+import numpy as np
+import pytest
+
+from bluefog_tpu import topology_util as tu
+from bluefog_tpu.core.plan import compile_plan
+from bluefog_tpu.parallel import ici_map
+
+
+@pytest.mark.parametrize("shape", [(4,), (2, 2), (4, 4), (2, 4), (4, 8), (2, 2, 2)])
+def test_snake_order_consecutive_adjacent(shape):
+    order = ici_map.snake_order(shape)
+    assert len(order) == int(np.prod(shape))
+    assert len(set(order)) == len(order)
+    for a, b in zip(order, order[1:]):
+        assert ici_map.hop_distance(a, b, shape) == 1, (a, b)
+
+
+@pytest.mark.parametrize("shape", [(4, 4), (2, 4), (4, 8), (2, 2, 2)])
+def test_snake_cycle_closes_for_even_leading_dim(shape):
+    order = ici_map.snake_order(shape)
+    assert ici_map.hop_distance(order[-1], order[0], shape) == 1
+
+
+def test_hop_distance_wraparound():
+    assert ici_map.hop_distance((0, 0), (3, 0), (4, 4)) == 1  # wrap link
+    assert ici_map.hop_distance((0, 0), (2, 2), (4, 4)) == 4
+    assert ici_map.hop_distance((0,), (7,), (16,)) == 7
+
+
+def test_ring_on_snake_is_all_single_hop():
+    shape = (4, 4)
+    order = ici_map.snake_order(shape)  # rank r at coord order[r]
+    plan = compile_plan(tu.RingGraph(16))
+    cost = ici_map.plan_hop_cost(plan, order, shape)
+    assert cost["max_edge_hops"] == 1.0
+    assert cost["total_hops"] == 32.0  # 32 directed edges, 1 hop each
+
+
+def test_snake_beats_random_for_exp2():
+    shape = (4, 4)
+    snake = ici_map.snake_order(shape)
+    rng = np.random.default_rng(0)
+    random_assign = [snake[i] for i in rng.permutation(16)]
+    plan = compile_plan(tu.ExponentialTwoGraph(16))
+    c_snake = ici_map.plan_hop_cost(plan, snake, shape)
+    c_rand = ici_map.plan_hop_cost(plan, random_assign, shape)
+    assert c_snake["total_hops"] < c_rand["total_hops"]
+
+
+def test_assignment_from_coords_roundtrip():
+    shape = (2, 4)
+    coords = ici_map.snake_order(shape)
+    shuffled = [coords[i] for i in np.random.default_rng(1).permutation(8)]
+    order = ici_map.assignment_from_coords(shuffled, shape)
+    # applying the order must yield snake-sequence coords
+    reordered = [shuffled[i] for i in order]
+    assert reordered == ici_map.snake_order(shape)
+
+
+def test_assignment_rejects_non_tiling_coords():
+    with pytest.raises(ValueError):
+        ici_map.assignment_from_coords([(0, 0), (0, 0)], (2, 1))
+
+
+def test_order_devices_fallback_without_coords(devices):
+    out = ici_map.order_devices_for_ring(list(devices))
+    assert out == list(devices)  # CPU devices have no coords

@@ -1,0 +1,66 @@
+"""Launcher env assembly + pure-Python timeline fallback tests."""
+
+import argparse
+import json
+
+import pytest
+
+from bluefog_tpu.run.launcher import build_env, main
+
+
+def _args(**kw):
+    ns = argparse.Namespace(
+        np=None,
+        coordinator=None,
+        process_id=None,
+        simulate=0,
+        timeline=None,
+        verbose=False,
+        command=["python", "x.py"],
+    )
+    for k, v in kw.items():
+        setattr(ns, k, v)
+    return ns
+
+
+def test_build_env_simulate():
+    env = build_env(_args(simulate=8), base_env={})
+    assert "xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+    assert env["JAX_PLATFORMS"] == "cpu"
+
+
+def test_build_env_multihost():
+    env = build_env(
+        _args(np=4, coordinator="h:1234", process_id=2), base_env={"PATH": "/bin"}
+    )
+    assert env["JAX_COORDINATOR_ADDRESS"] == "h:1234"
+    assert env["JAX_NUM_PROCESSES"] == "4"
+    assert env["JAX_PROCESS_ID"] == "2"
+    assert env["PATH"] == "/bin"
+
+
+def test_build_env_flags():
+    env = build_env(_args(verbose=True, timeline="/tmp/t.json"), base_env={})
+    assert env["BLUEFOG_LOG_LEVEL"] == "debug"
+    assert env["BLUEFOG_TIMELINE"] == "/tmp/t.json"
+
+
+def test_main_no_command_errors(capsys):
+    with pytest.raises(SystemExit):
+        main([])
+
+
+def test_python_timeline_fallback(tmp_path, monkeypatch):
+    """Force the pure-Python writer (native disabled) and check the JSON."""
+    from bluefog_tpu import timeline as tl
+
+    path = str(tmp_path / "py_trace.json")
+    monkeypatch.setenv("BLUEFOG_TIMELINE", path)
+    monkeypatch.setattr(tl, "_writer", None)
+    w = tl.TimelineWriter(path)
+    w._native = None  # force fallback
+    w.record("span_x", 1.0, 2.0)
+    w.flush()
+    with open(path) as f:
+        data = json.load(f)
+    assert data["traceEvents"][0]["name"] == "span_x"
