@@ -1,0 +1,189 @@
+"""Transformer model family for the BASELINE configs: a BERT-style encoder
+(config #3: async push-sum fine-tune) and a Llama-style decoder LM
+(config #5: decentralized pretraining).
+
+The reference has no attention code at all (SURVEY.md §2.3/§5.7) — these
+models exist because the rebuild's tracked configs name BERT-base and
+Llama-3-8B as gossip-training workloads; the architectures are the standard
+public ones, written TPU-first: bfloat16 matmul compute with float32
+accumulation/norms, static shapes, and optional *ring-attention sequence
+parallelism* (``bluefog_tpu.parallel.ring_attention``) so long contexts
+shard across the mesh — composing with the gossip data parallelism.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["BertEncoder", "LlamaLM", "dense_attention"]
+
+
+def dense_attention(q, k, v, *, causal: bool, dtype=jnp.float32):
+    """Plain softmax attention, [B, T, H, D] layout; fp32 softmax."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool))
+        scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+# --------------------------------------------------------------------------
+# BERT-style encoder
+# --------------------------------------------------------------------------
+
+
+class _EncoderBlock(nn.Module):
+    num_heads: int
+    dff: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, mask):
+        d = x.shape[-1]
+        h = nn.LayerNorm(dtype=jnp.float32)(x)
+        qkv = nn.DenseGeneral(
+            (3, self.num_heads, d // self.num_heads), dtype=self.dtype
+        )(h)
+        q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+        if mask is not None:
+            scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
+        att = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        att = att.reshape(att.shape[:2] + (d,))
+        x = x + nn.Dense(d, dtype=self.dtype)(att)
+        h = nn.LayerNorm(dtype=jnp.float32)(x)
+        h = nn.Dense(self.dff, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(d, dtype=self.dtype)(h)
+        return x
+
+
+class BertEncoder(nn.Module):
+    """BERT-style encoder with a classification head (the push-sum
+    fine-tuning workload of BASELINE config #3)."""
+
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    dff: int = 3072
+    max_len: int = 512
+    num_classes: int = 2
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None):
+        B, T = input_ids.shape
+        tok = nn.Embed(self.vocab_size, self.hidden_size, dtype=self.dtype)(input_ids)
+        pos = self.param(
+            "pos_embedding",
+            nn.initializers.normal(0.02),
+            (self.max_len, self.hidden_size),
+        )
+        x = tok + pos[None, :T].astype(self.dtype)
+        for _ in range(self.num_layers):
+            x = _EncoderBlock(self.num_heads, self.dff, self.dtype)(x, attention_mask)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        pooled = jnp.tanh(nn.Dense(self.hidden_size, dtype=jnp.float32)(x[:, 0]))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(pooled)
+
+
+# --------------------------------------------------------------------------
+# Llama-style decoder LM
+# --------------------------------------------------------------------------
+
+
+def _rotary(x, positions):
+    """Rotary position embedding; x: [B, T, H, D], positions: [T]."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, half]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones_init(), (x.shape[-1],))
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        return (x.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6) * scale).astype(
+            self.dtype
+        )
+
+
+class _DecoderBlock(nn.Module):
+    num_heads: int
+    dff: int
+    dtype: Any
+    attention_fn: Optional[Callable] = None  # (q, k, v) -> out, e.g. ring attn
+
+    @nn.compact
+    def __call__(self, x, positions):
+        d = x.shape[-1]
+        hd = d // self.num_heads
+        h = RMSNorm(dtype=self.dtype)(x)
+        q = nn.DenseGeneral((self.num_heads, hd), use_bias=False, dtype=self.dtype)(h)
+        k = nn.DenseGeneral((self.num_heads, hd), use_bias=False, dtype=self.dtype)(h)
+        v = nn.DenseGeneral((self.num_heads, hd), use_bias=False, dtype=self.dtype)(h)
+        q = _rotary(q, positions)
+        k = _rotary(k, positions)
+        if self.attention_fn is not None:
+            att = self.attention_fn(q, k, v)
+        else:
+            att = dense_attention(q, k, v, causal=True, dtype=self.dtype)
+        att = att.reshape(att.shape[:2] + (d,))
+        x = x + nn.Dense(d, use_bias=False, dtype=self.dtype)(att)
+        h = RMSNorm(dtype=self.dtype)(x)
+        gate = nn.Dense(self.dff, use_bias=False, dtype=self.dtype)(h)
+        up = nn.Dense(self.dff, use_bias=False, dtype=self.dtype)(h)
+        x = x + nn.Dense(d, use_bias=False, dtype=self.dtype)(nn.silu(gate) * up)
+        return x
+
+
+class LlamaLM(nn.Module):
+    """Llama-style decoder-only LM: RMSNorm, rotary, SwiGLU, no biases.
+
+    ``attention_fn`` plugs in sequence-parallel ring attention; when set,
+    ``positions`` must be the device's global positions (the caller knows
+    its sequence shard offset).
+    """
+
+    vocab_size: int = 32000
+    hidden_size: int = 512
+    num_layers: int = 4
+    num_heads: int = 8
+    dff: int = 1376
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None):
+        B, T = input_ids.shape
+        if positions is None:
+            positions = jnp.arange(T)
+        x = nn.Embed(self.vocab_size, self.hidden_size, dtype=self.dtype)(input_ids)
+        for _ in range(self.num_layers):
+            x = _DecoderBlock(
+                self.num_heads, self.dff, self.dtype, self.attention_fn
+            )(x, positions)
+        x = RMSNorm(dtype=jnp.float32)(x)
+        return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32)(x)

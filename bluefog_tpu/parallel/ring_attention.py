@@ -1,0 +1,93 @@
+"""Ring attention: sequence-parallel exact attention over a mesh axis.
+
+No sibling in the reference (it predates long-context work — SURVEY.md
+§5.7); this is the long-context capability the rebuild adds so the gossip
+data parallelism composes with sequence sharding on TPU.  The algorithm is
+the public blockwise ring attention (Liu et al., arXiv:2310.01889): each
+device holds one sequence block of Q, K, V; K/V blocks rotate around the
+ring one ``lax.ppermute`` hop per step (riding exactly the wraparound ICI
+links, see ``parallel/ici_map``) while each device accumulates its queries'
+attention with the online-softmax recurrence — compute overlaps the
+neighbor transfer, and no device ever materializes the full sequence.
+
+Layout: per-device ``q, k, v: [B, T_local, H, D]``; the global sequence is
+``axis_size * T_local`` in rank order along ``axis_name``.  Exactness (vs a
+single-device softmax over the full sequence) is tested to fp32 tolerance.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ring_attention", "make_ring_attention_fn"]
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    axis_size: int,
+    *,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Exact blockwise attention across sequence shards on ``axis_name``.
+
+    q, k, v: [B, T_local, H, D] (this device's sequence block).
+    Returns [B, T_local, H, D] in q's dtype.
+    """
+    n = axis_size
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    idx = lax.axis_index(axis_name)
+
+    qf = q.astype(jnp.float32)
+    m = jnp.full((B, H, Tq), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, H, Tq), jnp.float32)
+    o = jnp.zeros((B, Tq, H, D), jnp.float32)
+    perm = tuple((i, (i + 1) % n) for i in range(n))
+
+    kv = (k.astype(jnp.float32), v.astype(jnp.float32))
+    for step in range(n):
+        kb, vb = kv
+        j = (idx - step) % n  # which global block this device holds now
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kb) * scale
+        if causal:
+            gq = idx * Tq + jnp.arange(Tq)  # global query positions
+            gk = j * Tk + jnp.arange(Tk)  # global key positions
+            mask = gk[None, :] <= gq[:, None]  # [Tq, Tk]
+            valid = mask[None, None]
+        else:
+            valid = jnp.ones((1, 1, Tq, Tk), bool)
+        m_new = jnp.maximum(m, jnp.max(jnp.where(valid, scores, -jnp.inf), axis=-1))
+        # keep m finite where nothing has been seen yet (fully masked rows)
+        m_new = jnp.where(jnp.isfinite(m_new), m_new, m)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new), 0.0)  # [B,H,Tq]
+        p = jnp.where(valid, jnp.exp(scores - m_new[..., None]), 0.0)
+        p = jnp.where(jnp.isfinite(m_new)[..., None], p, 0.0)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        o = o * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, vb
+        )
+        m = m_new
+        if step != n - 1:
+            kv = lax.ppermute(kv, axis_name, perm)
+
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return (o / denom).astype(q.dtype)
+
+
+def make_ring_attention_fn(axis_name: str, axis_size: int, causal: bool = True
+                           ) -> Callable:
+    """attention_fn for ``models.transformer.LlamaLM``: plugs sequence-
+    parallel ring attention into the decoder blocks."""
+    return partial(
+        ring_attention, axis_name=axis_name, axis_size=axis_size, causal=causal
+    )

@@ -1,0 +1,122 @@
+"""BERT-style async push-sum fine-tuning — BASELINE config #3.
+
+Each rank fine-tunes a (scaled-down by default) BERT encoder on its private
+shard of a synthetic sentence-classification task; instead of any global
+reduction, ranks exchange parameters with ``win_accumulate`` push-sum gossip
+on a *directed* ring — the asymmetric-topology algorithm the reference's
+one-sided window ops exist for (``DistributedWinPutOptimizer`` family,
+SURVEY.md §2.3 "asynchronous decentralized DP").
+
+Run (CPU, 8 virtual ranks):
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/jax_bert_pushsum.py --steps 30
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import bluefog_tpu as bf
+from bluefog_tpu import topology_util
+from bluefog_tpu.models.transformer import BertEncoder
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=60)
+    parser.add_argument("--batch-size", type=int, default=16)
+    parser.add_argument("--seq-len", type=int, default=16)
+    parser.add_argument("--hidden", type=int, default=64)
+    parser.add_argument("--layers", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=3e-3)
+    args = parser.parse_args()
+
+    bf.init()
+    n = bf.size()
+    # directed ring: push-sum handles the column-stochastic asymmetry
+    bf.set_topology(topology_util.RingGraph(n, connect_style=1))
+    bf.turn_on_win_ops_with_associated_p()
+
+    model = BertEncoder(
+        vocab_size=128,
+        hidden_size=args.hidden,
+        num_layers=args.layers,
+        num_heads=4,
+        dff=args.hidden * 4,
+        max_len=args.seq_len,
+        num_classes=2,
+        dtype=jnp.float32,
+    )
+    rng = np.random.default_rng(0)
+    # synthetic balanced task: label = first token in the upper half of the
+    # vocabulary (readable from the CLS position, learns in tens of steps)
+    def make_batch(m):
+        ids = rng.integers(0, 128, size=(m, args.seq_len))
+        y = (ids[:, 0] >= 64).astype(np.int32)
+        return jnp.asarray(ids), jnp.asarray(y)
+
+    ids0, _ = make_batch(1)
+    params0 = model.init(jax.random.PRNGKey(0), ids0)["params"]
+    params = bf.broadcast_parameters(
+        jax.tree_util.tree_map(
+            lambda a: jnp.broadcast_to(a[None], (n,) + a.shape), params0
+        )
+    )
+
+    flat0, treedef = jax.tree_util.tree_flatten(params)
+    for i, leaf in enumerate(flat0):
+        bf.win_create(leaf, f"bert.{i}", zero_init=True)
+
+    opt = optax.adam(args.lr)
+    opt_state = opt.init(params)
+
+    def rank_loss(p, ids, y):
+        logits = model.apply({"params": p}, ids)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+    grad_fn = jax.jit(jax.vmap(jax.value_and_grad(rank_loss), in_axes=(0, 0, 0)))
+    dst = [{(r + 1) % n: 0.5} for r in range(n)]
+    ones_prev = [{(r - 1) % n: 1.0} for r in range(n)]
+
+    for step in range(args.steps):
+        bx = np.stack([np.asarray(make_batch(args.batch_size)[0]) for _ in range(n)])
+        by = jnp.asarray((bx[:, :, 0] >= 64).astype(np.int32))
+        loss, grads = grad_fn(params, jnp.asarray(bx), by)
+        updates, opt_state = jax.jit(opt.update)(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        # push-sum gossip: accumulate half to successor, keep half, debias
+        flat, _ = jax.tree_util.tree_flatten(params)
+        merged = []
+        for i, leaf in enumerate(flat):
+            name = f"bert.{i}"
+            bf.win_accumulate(leaf, name, dst_weights=dst)
+            m = bf.win_update(
+                name, self_weight=0.5, neighbor_weights=ones_prev, reset=True
+            )
+            p_assoc = bf.win_associated_p(name)
+            merged.append(
+                m / p_assoc.reshape((n,) + (1,) * (m.ndim - 1)).astype(m.dtype)
+            )
+            # reset p for the next round's debiasing
+            from bluefog_tpu import windows as W
+
+            W._win(name).p_self = jnp.ones_like(W._win(name).p_self)
+            W._win(name).self_tensor = merged[-1]
+        params = jax.tree_util.tree_unflatten(treedef, merged)
+        if (step + 1) % 10 == 0:
+            print(f"step {step + 1:3d}: mean loss {float(np.asarray(loss).mean()):.4f}")
+
+    bx, by = make_batch(256)
+    logits = model.apply(
+        {"params": jax.tree_util.tree_map(lambda a: a[0], params)}, bx
+    )
+    acc = float(jnp.mean((jnp.argmax(logits, -1) == by)))
+    print(f"final rank-0 accuracy on fresh data: {acc:.3f}")
+    bf.shutdown()
+
+
+if __name__ == "__main__":
+    main()
