@@ -1,0 +1,80 @@
+"""Checkpoint/resume helpers.
+
+The reference has no framework-level checkpointing (SURVEY.md §5.4):
+examples use ``torch.save``/``load`` plus ``bf.broadcast_parameters`` /
+``bf.broadcast_optimizer_state`` from rank 0 for consistent restarts.  The
+TPU-native equivalent pairs orbax (the JAX checkpoint library) with the
+same broadcast-on-restore idiom; ``save``/``restore`` here work on any
+pytree (params, optimizer state, window state).
+
+Decentralized nuance: ranks hold *different* parameters by design, so two
+modes exist —
+- ``mode="rank0"`` (the reference's idiom): persist rank 0's slice, restore
+  broadcast to every rank;
+- ``mode="all"``: persist the full rank-major array (exact training-state
+  resume, including disagreement between ranks).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bluefog_tpu.core import basics
+
+__all__ = ["save", "restore", "save_consensus", "restore_broadcast"]
+
+
+def _ckptr():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save(path: str, tree: Any, *, mode: str = "all") -> None:
+    """Persist a (rank-major) pytree.  mode='rank0' stores only rank 0's
+    slice (smaller, the reference's semantic); mode='all' stores everything.
+    """
+    if mode == "rank0":
+        tree = jax.tree_util.tree_map(
+            lambda a: np.asarray(a[0]) if getattr(a, "ndim", 0) >= 1 else np.asarray(a),
+            tree,
+        )
+    else:
+        tree = jax.tree_util.tree_map(np.asarray, tree)
+    _ckptr().save(os.path.abspath(path), tree, force=True)
+
+
+def restore(path: str) -> Any:
+    """Load a pytree saved by :func:`save` (mode='all' layout)."""
+    return _ckptr().restore(os.path.abspath(path))
+
+
+def save_consensus(path: str, tree: Any) -> None:
+    """Persist the rank-averaged model — the natural artifact of gossip
+    training (all ranks converge to it)."""
+    tree = jax.tree_util.tree_map(
+        lambda a: np.asarray(jnp.mean(jnp.asarray(a), axis=0))
+        if getattr(a, "ndim", 0) >= 1
+        else np.asarray(a),
+        tree,
+    )
+    _ckptr().save(os.path.abspath(path), tree, force=True)
+
+
+def restore_broadcast(path: str, *, root_rank: int = 0) -> Any:
+    """Restore a rank-0/consensus checkpoint and replicate it rank-major to
+    every rank (the reference's ``load + broadcast_parameters`` restart
+    idiom [U])."""
+    single = _ckptr().restore(os.path.abspath(path))
+    n = basics.size()
+    return jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(jnp.asarray(a)[None], (n,) + jnp.asarray(a).shape)
+        if np.asarray(a).ndim >= 1
+        else jnp.asarray(a),
+        single,
+    )

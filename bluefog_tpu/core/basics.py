@@ -15,6 +15,7 @@ API arrays are **rank-major**: leading axis = rank, sharded over the mesh.
 from __future__ import annotations
 
 import math
+import os
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -176,18 +177,28 @@ def init(
     *,
     devices: Optional[Sequence[jax.Device]] = None,
     local_size: Optional[int] = None,
+    distributed: Optional[bool] = None,
 ) -> None:
     """Initialize bluefog_tpu (reference ``bf.init()`` — SURVEY.md §3.1).
 
-    In a multi-host TPU pod, call ``jax.distributed.initialize()`` first (or
-    launch via ``bftpu-run``, which does); ``init`` then builds the global
-    mesh over all devices.  Default topology: ``ExponentialTwoGraph(size)``
-    (the reference's default).
+    Multi-host: when ``distributed`` is True — or left None with a
+    coordinator address in the environment (``JAX_COORDINATOR_ADDRESS``, as
+    exported by ``bftpu-run``) — ``jax.distributed.initialize()`` runs
+    first (the TPU-native ``MPI_Init``), then the mesh spans every process's
+    devices.  Default topology: ``ExponentialTwoGraph(size)`` (the
+    reference's default).
 
     ``local_size`` overrides devices-per-machine for hierarchical ops; by
     default it is ``jax.local_device_count()``.
     """
     global _context
+    if distributed is None:
+        distributed = bool(
+            os.environ.get("JAX_COORDINATOR_ADDRESS")
+            or os.environ.get("COORDINATOR_ADDRESS")
+        )
+    if distributed and jax.process_count() == 1:
+        jax.distributed.initialize()
     _context = BlueFogContext(devices=devices, local_size=local_size, topology=topology)
 
 

@@ -1,0 +1,83 @@
+"""PyTorch interop: run bluefog_tpu collectives on torch tensors.
+
+Sibling of the reference's second-framework layer (the experimental
+``bluefog/tensorflow`` support and the ``bluefog/torch`` adapter that
+translates framework tensors to the runtime's tensor abstraction —
+SURVEY.md §2.1/§2.2).  Here the translation is zero-copy where possible
+(dlpack) and the full eager op surface works on torch CPU tensors: torch in
+this environment is CPU-only, so tensors round-trip through the mesh's
+device memory around each op.
+
+Usage:
+    from bluefog_tpu.interop import torch_adapter as bft
+    out = bft.neighbor_allreduce(torch_tensor)   # rank-major torch tensor
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "to_jax",
+    "to_torch",
+    "allreduce",
+    "broadcast",
+    "allgather",
+    "neighbor_allreduce",
+    "neighbor_allgather",
+    "hierarchical_neighbor_allreduce",
+]
+
+
+def _torch():
+    import torch
+
+    return torch
+
+
+def to_jax(t) -> jnp.ndarray:
+    """torch.Tensor -> jax array.
+
+    Goes through numpy (shares memory with the CPU tensor, one copy to
+    device) rather than dlpack: dlpack imports arrive *committed* to a
+    single device, which blocks the jit/shard_map resharding the rank-major
+    ops rely on.
+    """
+    torch = _torch()
+    if not isinstance(t, torch.Tensor):
+        return jnp.asarray(t)
+    return jnp.asarray(t.detach().cpu().contiguous().numpy())
+
+
+def to_torch(a):
+    """jax array -> torch.Tensor."""
+    torch = _torch()
+    try:
+        return torch.from_dlpack(a)
+    except Exception:
+        return torch.from_numpy(np.asarray(a))
+
+
+def _wrap(op_name: str):
+    def fn(tensor, *args, **kwargs):
+        from bluefog_tpu import ops
+
+        out = getattr(ops, op_name)(to_jax(tensor), *args, **kwargs)
+        return jax.tree_util.tree_map(to_torch, out)
+
+    fn.__name__ = op_name
+    fn.__doc__ = f"torch-tensor veneer over bluefog_tpu.ops.{op_name}"
+    return fn
+
+
+allreduce = _wrap("allreduce")
+broadcast = _wrap("broadcast")
+allgather = _wrap("allgather")
+neighbor_allreduce = _wrap("neighbor_allreduce")
+neighbor_allgather = _wrap("neighbor_allgather")
+hierarchical_neighbor_allreduce = _wrap("hierarchical_neighbor_allreduce")

@@ -1,0 +1,47 @@
+"""Interactive/notebook support — sibling of the reference's ``ibfrun``.
+
+The reference needs ``ibfrun`` (``bluefog/run/interactive_run.py`` [U],
+SURVEY.md §2.2) to keep persistent MPI worker daemons alive so Jupyter
+cells can issue collective ops.  Under single-controller JAX the need
+dissolves: one process drives every rank, so a notebook only has to build
+the mesh.  ``setup_interactive`` does that — optionally simulating an
+n-rank CPU mesh inside the running kernel (the notebook twin of
+``bftpu-run --simulate``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["setup_interactive"]
+
+
+def setup_interactive(simulate_ranks: Optional[int] = None, **init_kwargs):
+    """Initialize bluefog_tpu for interactive use and return the context.
+
+    simulate_ranks: force an n-device virtual CPU mesh (must be called
+    before jax initializes its backends — i.e. first thing in the notebook).
+    """
+    if simulate_ranks:
+        flags = os.environ.get("XLA_FLAGS", "")
+        token = f"--xla_force_host_platform_device_count={simulate_ranks}"
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (flags + " " + token).strip()
+        import jax
+
+        if jax.default_backend() != "cpu":
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception as e:  # backends already initialized
+                raise RuntimeError(
+                    "setup_interactive(simulate_ranks=...) must run before "
+                    "any jax computation in this kernel"
+                ) from e
+
+    import bluefog_tpu as bf
+
+    bf.init(**init_kwargs)
+    from bluefog_tpu.core import basics
+
+    return basics.context()

@@ -1,0 +1,123 @@
+"""ResNet/CIFAR-style decentralized training — JAX twin of the reference's
+``examples/pytorch_cifar10_resnet.py`` [U] (SURVEY.md §2.2).
+
+Trains a small-image ResNet-18 with ATC gossip on CIFAR-10 if present at
+$CIFAR_NPZ, else a structured synthetic stand-in (zero-egress environment).
+
+Run (CPU, 8 virtual ranks):
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/jax_cifar_resnet.py --epochs 1 --filters 8
+"""
+
+import argparse
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import bluefog_tpu as bf
+from bluefog_tpu import topology_util
+from bluefog_tpu.core import basics
+from bluefog_tpu.models import ResNet18
+from bluefog_tpu.optim import CommunicationType
+from bluefog_tpu.training import make_decentralized_train_step, replicate_for_mesh
+
+
+def load_cifar(n_train, n_test, rng):
+    path = os.environ.get("CIFAR_NPZ", "/data/cifar10.npz")
+    if os.path.exists(path):
+        d = np.load(path)
+        return (
+            d["x_train"][:n_train] / 255.0,
+            d["y_train"][:n_train].astype(np.int32),
+            d["x_test"][:n_test] / 255.0,
+            d["y_test"][:n_test].astype(np.int32),
+        )
+    # synthetic: colored blob templates per class
+    templates = rng.normal(size=(10, 32, 32, 3)).astype(np.float32)
+    for _ in range(3):
+        templates = (
+            templates
+            + np.roll(templates, 1, 1)
+            + np.roll(templates, -1, 1)
+            + np.roll(templates, 1, 2)
+            + np.roll(templates, -1, 2)
+        ) / 5.0
+
+    def make(m):
+        y = rng.integers(0, 10, size=m)
+        x = templates[y] + 0.6 * rng.normal(size=(m, 32, 32, 3)).astype(np.float32)
+        return x.astype(np.float32), y.astype(np.int32)
+
+    xtr, ytr = make(n_train)
+    xte, yte = make(n_test)
+    return xtr, ytr, xte, yte
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--batch-size", type=int, default=8, help="per rank")
+    parser.add_argument("--train-size", type=int, default=1024)
+    parser.add_argument("--filters", type=int, default=16)
+    parser.add_argument("--lr", type=float, default=0.05)
+    args = parser.parse_args()
+
+    bf.init()
+    n = bf.size()
+    bf.set_topology(topology_util.ExponentialTwoGraph(n))
+    ctx = basics.context()
+    rng = np.random.default_rng(0)
+    xtr, ytr, xte, yte = load_cifar(args.train_size, 256, rng)
+    per_rank = len(xtr) // n
+    xtr = xtr[: per_rank * n].reshape(n, per_rank, 32, 32, 3)
+    ytr = ytr[: per_rank * n].reshape(n, per_rank)
+
+    model = ResNet18(num_classes=10, num_filters=args.filters, small_images=True)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 32, 32, 3)), train=True
+    )
+    params = replicate_for_mesh(variables["params"], n)
+    bstats = replicate_for_mesh(variables["batch_stats"], n)
+
+    init_fn, step_fn = make_decentralized_train_step(
+        model.apply,
+        optax.sgd(args.lr, momentum=0.9),
+        ctx.mesh,
+        communication_type=CommunicationType.neighbor_allreduce,
+        plan=ctx.plan,
+        has_batch_stats=True,
+        donate=False,
+    )
+    state = init_fn(params)
+
+    steps = per_rank // args.batch_size
+    for epoch in range(args.epochs):
+        perm = rng.permutation(per_rank)
+        loss = None
+        for s in range(steps):
+            idx = perm[s * args.batch_size : (s + 1) * args.batch_size]
+            bx = jnp.asarray(xtr[:, idx])
+            by = jnp.asarray(ytr[:, idx])
+            params, bstats, state, loss, _ = step_fn(params, bstats, state, bx, by)
+        jax.block_until_ready(params)
+        logits = model.apply(
+            {
+                "params": jax.tree_util.tree_map(lambda a: a[0], params),
+                "batch_stats": jax.tree_util.tree_map(lambda a: a[0], bstats),
+            },
+            jnp.asarray(xte),
+            train=False,
+        )
+        acc = float(jnp.mean(jnp.argmax(logits, -1) == jnp.asarray(yte)))
+        print(
+            f"epoch {epoch + 1}: test acc {acc:.4f}, "
+            f"train loss {float(np.asarray(loss).mean()):.4f}"
+        )
+    bf.shutdown()
+
+
+if __name__ == "__main__":
+    main()

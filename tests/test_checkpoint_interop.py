@@ -1,0 +1,88 @@
+"""Checkpoint (orbax) and torch-interop tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import checkpoint as ckpt
+from bluefog_tpu import topology_util as tu
+
+SIZE = 8
+
+
+@pytest.fixture(autouse=True)
+def fresh_context(devices):
+    bf.init(local_size=2)
+    yield
+    bf.shutdown()
+
+
+def _params():
+    return {
+        "w": jnp.arange(SIZE * 3, dtype=jnp.float32).reshape(SIZE, 3),
+        "b": jnp.ones((SIZE, 2)),
+    }
+
+
+def test_save_restore_all(tmp_path):
+    p = _params()
+    path = str(tmp_path / "ck_all")
+    ckpt.save(path, p, mode="all")
+    r = ckpt.restore(path)
+    np.testing.assert_allclose(np.asarray(r["w"]), np.asarray(p["w"]))
+    np.testing.assert_allclose(np.asarray(r["b"]), np.asarray(p["b"]))
+
+
+def test_save_rank0_restore_broadcast(tmp_path):
+    p = _params()
+    path = str(tmp_path / "ck_r0")
+    ckpt.save(path, p, mode="rank0")
+    r = ckpt.restore_broadcast(path)
+    # every rank's slice equals rank 0's original
+    for k in p:
+        out = np.asarray(r[k])
+        assert out.shape == np.asarray(p[k]).shape
+        for rank in range(SIZE):
+            np.testing.assert_allclose(out[rank], np.asarray(p[k])[0])
+
+
+def test_save_consensus(tmp_path):
+    p = _params()
+    path = str(tmp_path / "ck_mean")
+    ckpt.save_consensus(path, p)
+    r = ckpt.restore(path)
+    np.testing.assert_allclose(
+        np.asarray(r["w"]), np.asarray(p["w"]).mean(axis=0), rtol=1e-6
+    )
+
+
+def test_torch_interop_roundtrip_and_ops():
+    torch = pytest.importorskip("torch")
+    from bluefog_tpu.interop import torch_adapter as bft
+
+    bf.set_topology(tu.RingGraph(SIZE))
+    t = torch.arange(SIZE * 4, dtype=torch.float32).reshape(SIZE, 4)
+    out = bft.neighbor_allreduce(t)
+    assert isinstance(out, torch.Tensor)
+    W = tu.GetWeightMatrix(tu.RingGraph(SIZE))
+    expected = W @ t.numpy()
+    np.testing.assert_allclose(out.numpy(), expected, rtol=1e-5)
+
+    s = bft.allreduce(t)
+    np.testing.assert_allclose(s.numpy(), t.numpy().mean(axis=0)[None].repeat(SIZE, 0), rtol=1e-5)
+
+    b = bft.broadcast(t, root_rank=3)
+    np.testing.assert_allclose(b.numpy(), np.tile(t.numpy()[3], (SIZE, 1)), rtol=1e-6)
+
+
+def test_torch_interop_conversion_helpers():
+    torch = pytest.importorskip("torch")
+    from bluefog_tpu.interop.torch_adapter import to_jax, to_torch
+
+    t = torch.randn(3, 4)
+    a = to_jax(t)
+    assert a.shape == (3, 4)
+    back = to_torch(a)
+    np.testing.assert_allclose(back.numpy(), t.numpy(), rtol=1e-6)
