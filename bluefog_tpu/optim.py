@@ -256,13 +256,31 @@ class _EagerDistributedOptimizer:
         """
         return self._transform().init(params)
 
-    def step(self, params, grads, state):
-        """One distributed step: returns (new_params, new_state)."""
-        tx = self._transform()
+    def step(self, params, grads, state, plan: "CommPlan" = None):
+        """One distributed step: returns (new_params, new_state).
+
+        ``plan`` overrides the installed topology's plan for this call —
+        the reference's *dynamic topology* optimizer path (one-peer
+        rotations etc.).  Rotating through a small set of plans (e.g. the
+        log(n) exp-2 one-peer permutations) reuses cached compilations.
+        """
+        if plan is not None:
+            if self.communication_type != CommunicationType.neighbor_allreduce:
+                raise ValueError("per-step plan override requires neighbor_allreduce")
+            comm_fn = make_spmd_comm_fn(self.communication_type, plan)
+            builder = {
+                "atc": adapt_then_combine_spmd,
+                "awc": adapt_with_combine_spmd,
+            }[self._mode]
+            tx = builder(self.base, comm_fn, self.k)
+            tx_key = (plan,)
+        else:
+            tx = self._transform()
+            tx_key = self._tx_key
         mesh, spec = self._mesh_specs()
         ctx = basics.context()
         state_spec = _state_specs(state, ctx.size, spec)
-        key = (self._tx_key, jax.tree_util.tree_structure(state))
+        key = (tx_key, jax.tree_util.tree_structure(state))
 
         def whole(params, grads, state):
             updates, new_state = tx.update(grads, state, params)
@@ -378,6 +396,30 @@ class DistributedWinPutOptimizer:
             for name in [n for n in ctx.windows if n.startswith(self.prefix + ".")]:
                 windows.win_free(name)
             self._created = False
+
+
+def one_peer_plan_schedule(size: int):
+    """The exp-2 one-peer rotation as a list of CommPlans to cycle through
+    (``opt.step(..., plan=plans[t % len(plans)])``) — the compiled-variant
+    set SURVEY.md §7 prescribes for dynamic topologies (each plan is a
+    single ppermute; log2(n) distinct compilations total)."""
+    import math as _math
+
+    from bluefog_tpu.core.plan import plan_from_neighbor_lists
+
+    if size <= 1:
+        return [plan_from_neighbor_lists(size, [[] for _ in range(size)])]
+    nbits = max(1, int(_math.ceil(_math.log2(size))))
+    plans = []
+    seen = set()
+    for t in range(nbits):
+        off = (1 << t) % size or 1
+        if off in seen:
+            continue
+        seen.add(off)
+        srcs = [[(r - off) % size] for r in range(size)]
+        plans.append(plan_from_neighbor_lists(size, srcs))
+    return plans
 
 
 # --------------------------------------------------------------------------

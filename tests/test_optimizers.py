@@ -205,3 +205,25 @@ def test_broadcast_parameters_and_state():
     bstate = bf.broadcast_optimizer_state(state, root_rank=2)
     mu = jax.tree_util.tree_leaves(bstate)
     assert len(mu) > 0
+
+
+def test_dynamic_one_peer_plan_schedule():
+    """ATC with a rotating one-peer plan must preserve the global average
+    and contract to consensus (the reference's dynamic-topology optimizer
+    path)."""
+    from bluefog_tpu.optim import one_peer_plan_schedule
+
+    plans = one_peer_plan_schedule(SIZE)
+    assert len(plans) == 3  # offsets 1, 2, 4
+    assert all(len(p.classes) == 1 for p in plans)
+    opt = bf.DistributedAdaptThenCombineOptimizer(optax.sgd(0.0))
+    rng = np.random.default_rng(9)
+    params = {"w": jnp.asarray(rng.normal(size=(SIZE, 4)).astype(np.float32))}
+    mean0 = np.asarray(params["w"]).mean(axis=0)
+    state = opt.init(params)
+    grads = {"w": jnp.zeros_like(params["w"])}
+    for t in range(9):
+        params, state = opt.step(params, grads, state, plan=plans[t % len(plans)])
+    out = np.asarray(params["w"])
+    np.testing.assert_allclose(out.mean(axis=0), mean0, rtol=1e-5)
+    assert out.std(axis=0).max() < 1e-4  # 9 one-peer exp2 rounds => consensus
