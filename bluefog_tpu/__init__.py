@@ -85,6 +85,7 @@ from bluefog_tpu.optim import (
     DistributedAdaptWithCombineOptimizer,
     DistributedGradientAllreduceOptimizer,
     DistributedWinPutOptimizer,
+    one_peer_plan_schedule,
     broadcast_parameters,
     broadcast_optimizer_state,
 )

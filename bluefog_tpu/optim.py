@@ -49,6 +49,7 @@ __all__ = [
     "DistributedAdaptWithCombineOptimizer",
     "DistributedGradientAllreduceOptimizer",
     "DistributedWinPutOptimizer",
+    "one_peer_plan_schedule",
     "broadcast_parameters",
     "broadcast_optimizer_state",
 ]
@@ -267,26 +268,36 @@ class _EagerDistributedOptimizer:
         if plan is not None:
             if self.communication_type != CommunicationType.neighbor_allreduce:
                 raise ValueError("per-step plan override requires neighbor_allreduce")
-            comm_fn = make_spmd_comm_fn(self.communication_type, plan)
-            builder = {
-                "atc": adapt_then_combine_spmd,
-                "awc": adapt_with_combine_spmd,
-            }[self._mode]
-            tx = builder(self.base, comm_fn, self.k)
+            world = basics.context().size
+            if plan.size != world:
+                raise ValueError(
+                    f"plan is for {plan.size} ranks, mesh has {world}"
+                )
+
+            def build_tx():
+                comm_fn = make_spmd_comm_fn(self.communication_type, plan)
+                builder = {
+                    "atc": adapt_then_combine_spmd,
+                    "awc": adapt_with_combine_spmd,
+                }[self._mode]
+                return builder(self.base, comm_fn, self.k)
+
             tx_key = (plan,)
         else:
-            tx = self._transform()
+            build_tx = self._transform
             tx_key = self._tx_key
         mesh, spec = self._mesh_specs()
         ctx = basics.context()
         state_spec = _state_specs(state, ctx.size, spec)
         key = (tx_key, jax.tree_util.tree_structure(state))
 
-        def whole(params, grads, state):
-            updates, new_state = tx.update(grads, state, params)
-            return optax.apply_updates(params, updates), new_state
-
         if key not in self._step_fns:
+            tx = build_tx()
+
+            def whole(params, grads, state):
+                updates, new_state = tx.update(grads, state, params)
+                return optax.apply_updates(params, updates), new_state
+
             self._step_fns[key] = jax.jit(
                 jax.shard_map(
                     whole,
@@ -406,20 +417,16 @@ def one_peer_plan_schedule(size: int):
     import math as _math
 
     from bluefog_tpu.core.plan import plan_from_neighbor_lists
+    from bluefog_tpu.topology_util import GetDynamicOnePeerSendRecvRanks
 
     if size <= 1:
         return [plan_from_neighbor_lists(size, [[] for _ in range(size)])]
     nbits = max(1, int(_math.ceil(_math.log2(size))))
-    plans = []
-    seen = set()
-    for t in range(nbits):
-        off = (1 << t) % size or 1
-        if off in seen:
-            continue
-        seen.add(off)
-        srcs = [[(r - off) % size] for r in range(size)]
-        plans.append(plan_from_neighbor_lists(size, srcs))
-    return plans
+    gens = [GetDynamicOnePeerSendRecvRanks(size, r) for r in range(size)]
+    return [
+        plan_from_neighbor_lists(size, [next(g)[1] for g in gens])
+        for _ in range(nbits)
+    ]
 
 
 # --------------------------------------------------------------------------
