@@ -1,0 +1,12 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference keeps its hot paths in hand-written native code (CUDA stream
+combines in ``bluefog/common/nccl_controller.cc`` [U], fused MPI combine
+loops in ``mpi_controller.cc`` [U]); the TPU-native analogue is Pallas —
+kernels compiled straight to Mosaic for the MXU/VPU, fused with XLA around
+them.
+"""
+
+from bluefog_tpu.kernels.flash_attention import flash_attention, make_flash_attention_fn
+
+__all__ = ["flash_attention", "make_flash_attention_fn"]

@@ -1,0 +1,269 @@
+"""Flash attention as a Pallas TPU kernel (forward) + blockwise XLA backward.
+
+No sibling in the reference — it has no attention at all (SURVEY.md §2.3) —
+but the rebuild's transformer workloads (BERT push-sum fine-tune, Llama
+gossip pretraining; BASELINE configs #3/#5) spend their FLOPs here, so the
+hot op gets a hand kernel the way the reference hand-codes its hot combine
+loops in native code (``nccl_controller.cc`` [U]).
+
+Forward: the standard online-softmax blocking (Dao et al., arXiv:2205.14135;
+blockwise form as in Liu et al., arXiv:2310.01889): grid over
+``(batch*heads, q_blocks, k_blocks)`` with the k axis innermost, carrying
+running max ``m``, normalizer ``l`` and the output accumulator in VMEM
+scratch across k iterations — O(T·block) memory instead of O(T²), q/k block
+matmuls on the MXU, fp32 accumulation regardless of input dtype.  Causal
+grids skip fully-masked k blocks via ``pl.when`` predication.
+
+Backward: custom VJP that recomputes per-k-block probabilities from the
+saved logsumexp (the flash trick — no O(T²) residuals) and accumulates
+dQ/dK/dV with a ``lax.fori_loop`` of plain XLA matmuls.  Recompute-based
+backward keeps memory O(T·block) and lets XLA fuse/schedule; a full Mosaic
+backward kernel is a later optimization, not a semantic change.
+
+On non-TPU platforms the same kernel runs in Pallas interpret mode (tests
+exercise the real kernel logic on the CPU mesh).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention", "make_flash_attention_fn"]
+
+_NEG_INF = -1e30  # finite sentinel: keeps exp() exact zeros without nan traps
+
+
+def _default_interpret() -> bool:
+    platform = jax.devices()[0].platform
+    return platform not in ("tpu", "axon")
+
+
+def _block_spec(shape, index_map):
+    return pl.BlockSpec(shape, index_map, memory_space=pltpu.VMEM)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_ref, l_ref,
+                *, scale: float, block_q: int, block_k: int, causal: bool,
+                num_k: int):
+    """One (bh, iq, jk) program: fold k-block jk into the online softmax."""
+    iq = pl.program_id(1)
+    jk = pl.program_id(2)
+
+    @pl.when(jk == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc[...] = jnp.zeros_like(acc)
+
+    def _body():
+        q = q_ref[0].astype(jnp.float32)  # [block_q, D]
+        k = k_ref[0].astype(jnp.float32)  # [block_k, D]
+        v = v_ref[0].astype(jnp.float32)  # [block_k, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [block_q, block_k]
+        if causal:
+            qpos = iq * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = jk * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(kpos <= qpos, s, _NEG_INF)
+        m_prev = m_ref[:, :1]  # [block_q, 1] (replicated columns)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)  # [block_q, 1]
+        p = jnp.exp(s - m_new)  # [block_q, block_k]
+        l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc[...] = acc[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    if causal:
+        # skip k blocks entirely above the diagonal
+        pl.when(jk * block_k <= (iq + 1) * block_q - 1)(_body)
+    else:
+        _body()
+
+    @pl.when(jk == num_k - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        safe_l = jnp.maximum(l, 1e-30)
+        o_ref[0] = (acc[...] / safe_l).astype(o_ref.dtype)
+        lse = m_ref[...] + jnp.log(jnp.maximum(l_ref[...], 1e-30))
+        lse_ref[0] = lse.astype(jnp.float32)
+
+
+def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+    """q,k,v: [BH, T, D] -> (o [BH, T, D], lse [BH, T, LANES])."""
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    if tq % block_q or tk % block_k:
+        raise ValueError(
+            f"sequence lengths ({tq}, {tk}) must divide by blocks "
+            f"({block_q}, {block_k})"
+        )
+    num_q, num_k = tq // block_q, tk // block_k
+    lanes = 128
+
+    grid = (bh, num_q, num_k)
+    kernel = functools.partial(
+        _fwd_kernel,
+        scale=scale,
+        block_q=block_q,
+        block_k=block_k,
+        causal=causal,
+        num_k=num_k,
+    )
+    scratch = [
+        pltpu.VMEM((block_q, d), jnp.float32),
+        pltpu.VMEM((block_q, lanes), jnp.float32),
+        pltpu.VMEM((block_q, lanes), jnp.float32),
+    ]
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            _block_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            _block_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            _block_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            _block_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            _block_spec((1, block_q, lanes), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tq, lanes), jnp.float32),
+        ],
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse[:, :, 0]
+
+
+def _blockwise_bwd(q, k, v, o, lse, g, *, scale, causal, block_k):
+    """dQ/dK/dV via per-k-block recompute from lse; all [BH, T, D] fp32."""
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    block_k = min(block_k, tk)
+    num_k = tk // block_k
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    of, gf = o.astype(jnp.float32), g.astype(jnp.float32)
+    delta = jnp.sum(of * gf, axis=-1, keepdims=True)  # [BH, Tq, 1]
+    qpos = jnp.arange(tq)
+
+    def body(j, carry):
+        dq, dk, dv = carry
+        kb = lax.dynamic_slice_in_dim(kf, j * block_k, block_k, axis=1)
+        vb = lax.dynamic_slice_in_dim(vf, j * block_k, block_k, axis=1)
+        s = jnp.einsum("bqd,bkd->bqk", qf, kb) * scale
+        if causal:
+            kpos = j * block_k + jnp.arange(block_k)
+            mask = kpos[None, :] <= qpos[:, None]
+            s = jnp.where(mask[None], s, _NEG_INF)
+        p = jnp.exp(s - lse[..., None])  # [BH, Tq, block_k]
+        dvb = jnp.einsum("bqk,bqd->bkd", p, gf)
+        dp = jnp.einsum("bqd,bkd->bqk", gf, vb)
+        ds = p * (dp - delta) * scale
+        dq = dq + jnp.einsum("bqk,bkd->bqd", ds, kb)
+        dkb = jnp.einsum("bqk,bqd->bkd", ds, qf)
+        dk = lax.dynamic_update_slice_in_dim(dk, dkb, j * block_k, axis=1)
+        dv = lax.dynamic_update_slice_in_dim(dv, dvb, j * block_k, axis=1)
+        return dq, dk, dv
+
+    init = (
+        jnp.zeros((bh, tq, d), jnp.float32),
+        jnp.zeros((bh, tk, d), jnp.float32),
+        jnp.zeros((bh, tk, d), jnp.float32),
+    )
+    dq, dk, dv = lax.fori_loop(0, num_k, body, init)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def _flash_core(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, _ = _flash_fwd(
+        q, k, v, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return o
+
+
+def _flash_core_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, lse = _flash_fwd(
+        q, k, v, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return o, (q, k, v, o, lse)
+
+
+def _flash_core_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v, o, lse = res
+    return _blockwise_bwd(
+        q, k, v, o, lse, g, scale=scale, causal=causal, block_k=block_k
+    )
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Memory-efficient exact attention; q, k, v: ``[B, T, H, D]``.
+
+    Drop-in for :func:`bluefog_tpu.models.transformer.dense_attention`
+    (same layout/semantics, fp32 softmax), O(T·block) memory.
+    """
+    if interpret is None:
+        interpret = _default_interpret()
+    b, tq, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+
+    def fold(x):  # [B, T, H, D] -> [B*H, T, D]
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    o = _flash_core(
+        fold(q), fold(k), fold(v), scale, causal, block_q, block_k, interpret
+    )
+    return o.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+
+
+def make_flash_attention_fn(
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> Callable:
+    """``attention_fn`` for :class:`bluefog_tpu.models.transformer.LlamaLM`."""
+    return functools.partial(
+        flash_attention,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=interpret,
+    )
