@@ -7,6 +7,14 @@ kernels compiled straight to Mosaic for the MXU/VPU, fused with XLA around
 them.
 """
 
-from bluefog_tpu.kernels.flash_attention import flash_attention, make_flash_attention_fn
+from bluefog_tpu.kernels.flash_attention import (
+    flash_attention,
+    flash_attention_with_lse,
+    make_flash_attention_fn,
+)
 
-__all__ = ["flash_attention", "make_flash_attention_fn"]
+__all__ = [
+    "flash_attention",
+    "flash_attention_with_lse",
+    "make_flash_attention_fn",
+]

@@ -12,13 +12,18 @@ blockwise form as in Liu et al., arXiv:2310.01889): grid over
 running max ``m``, normalizer ``l`` and the output accumulator in VMEM
 scratch across k iterations — O(T·block) memory instead of O(T²), q/k block
 matmuls on the MXU, fp32 accumulation regardless of input dtype.  Causal
-grids skip fully-masked k blocks via ``pl.when`` predication.
+masking works on *global* positions: the query/key start offsets ride in as
+SMEM scalars, so the same compiled kernel serves the single-device case
+(offsets 0) and one hop of ring attention (offsets = rotating block
+positions, including fully-masked hops, which predicate away at runtime).
 
 Backward: custom VJP that recomputes per-k-block probabilities from the
 saved logsumexp (the flash trick — no O(T²) residuals) and accumulates
 dQ/dK/dV with a ``lax.fori_loop`` of plain XLA matmuls.  Recompute-based
 backward keeps memory O(T·block) and lets XLA fuse/schedule; a full Mosaic
-backward kernel is a later optimization, not a semantic change.
+backward kernel is a later optimization, not a semantic change.  The lse
+output is itself differentiable (its cotangent folds into the dS term),
+which is what lets ring attention's logsumexp *merge* train end-to-end.
 
 On non-TPU platforms the same kernel runs in Pallas interpret mode (tests
 exercise the real kernel logic on the CPU mesh).
@@ -28,18 +33,19 @@ from __future__ import annotations
 
 import functools
 import math
-from typing import Callable, Optional
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
-
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention", "make_flash_attention_fn"]
+__all__ = ["flash_attention", "flash_attention_with_lse", "make_flash_attention_fn"]
 
-_NEG_INF = -1e30  # finite sentinel: keeps exp() exact zeros without nan traps
+_NEG_INF = -1e30  # finite mask sentinel (real scores can never reach it)
+_MASK_THRESH = -0.5e30  # "was this entry masked" test after sentinel fill
+_LANES = 128
 
 
 def _default_interpret() -> bool:
@@ -51,7 +57,18 @@ def _block_spec(shape, index_map):
     return pl.BlockSpec(shape, index_map, memory_space=pltpu.VMEM)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_ref, l_ref,
+def _out_struct(shape, dtype, operands):
+    """ShapeDtypeStruct whose varying-mesh-axes set is the union of the
+    operands' (required under shard_map's vma checking; empty outside)."""
+    try:
+        vma = frozenset().union(*(jax.typeof(x).vma for x in operands))
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except (AttributeError, TypeError):  # older jax: no vma tracking
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _fwd_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc, m_ref, l_ref,
                 *, scale: float, block_q: int, block_k: int, causal: bool,
                 num_k: int):
     """One (bh, iq, jk) program: fold k-block jk into the online softmax."""
@@ -72,10 +89,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_ref, l_ref,
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [block_q, block_k]
         if causal:
-            qpos = iq * block_q + lax.broadcasted_iota(
+            qpos = qs_ref[0, 0] + iq * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
-            kpos = jk * block_k + lax.broadcasted_iota(
+            kpos = ks_ref[0, 0] + jk * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(kpos <= qpos, s, _NEG_INF)
@@ -84,6 +101,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_ref, l_ref,
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)  # [block_q, 1]
         p = jnp.exp(s - m_new)  # [block_q, block_k]
+        if causal:
+            # fully-masked rows have m_new == sentinel and would otherwise
+            # contribute exp(0) == 1 per entry
+            p = jnp.where(s > _MASK_THRESH, p, 0.0)
         l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc[...] = acc[...] * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -92,22 +113,28 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_ref, l_ref,
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
     if causal:
-        # skip k blocks entirely above the diagonal
-        pl.when(jk * block_k <= (iq + 1) * block_q - 1)(_body)
+        # predicate away k blocks entirely above the diagonal (runtime skip:
+        # the offsets are dynamic, so this can't prune at compile time)
+        first_k = ks_ref[0, 0] + jk * block_k
+        last_q = qs_ref[0, 0] + (iq + 1) * block_q - 1
+        pl.when(first_k <= last_q)(_body)
     else:
         _body()
 
     @pl.when(jk == num_k - 1)
     def _finish():
         l = l_ref[:, :1]
-        safe_l = jnp.maximum(l, 1e-30)
-        o_ref[0] = (acc[...] / safe_l).astype(o_ref.dtype)
+        o_ref[0] = (acc[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
         lse = m_ref[...] + jnp.log(jnp.maximum(l_ref[...], 1e-30))
         lse_ref[0] = lse.astype(jnp.float32)
 
 
-def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
-    """q,k,v: [BH, T, D] -> (o [BH, T, D], lse [BH, T, LANES])."""
+def _flash_fwd(q, k, v, q_start, k_start, *, scale, causal, block_q, block_k,
+               interpret):
+    """q,k,v: [BH, T, D]; q_start/k_start: int32 scalars (global offsets).
+
+    Returns (o [BH, Tq, D], lse [BH, Tq]).
+    """
     bh, tq, d = q.shape
     tk = k.shape[1]
     block_q = min(block_q, tq)
@@ -118,9 +145,9 @@ def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
             f"({block_q}, {block_k})"
         )
     num_q, num_k = tq // block_q, tk // block_k
-    lanes = 128
 
-    grid = (bh, num_q, num_k)
+    qs = jnp.asarray(q_start, jnp.int32).reshape(1, 1)
+    ks = jnp.asarray(k_start, jnp.int32).reshape(1, 1)
     kernel = functools.partial(
         _fwd_kernel,
         scale=scale,
@@ -129,35 +156,44 @@ def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
         causal=causal,
         num_k=num_k,
     )
-    scratch = [
-        pltpu.VMEM((block_q, d), jnp.float32),
-        pltpu.VMEM((block_q, lanes), jnp.float32),
-        pltpu.VMEM((block_q, lanes), jnp.float32),
-    ]
+    smem = pl.BlockSpec((1, 1), lambda b, i, j: (0, 0),
+                        memory_space=pltpu.SMEM)
     o, lse = pl.pallas_call(
         kernel,
-        grid=grid,
+        grid=(bh, num_q, num_k),
         in_specs=[
+            smem,
+            smem,
             _block_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             _block_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             _block_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
             _block_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            _block_spec((1, block_q, lanes), lambda b, i, j: (b, i, 0)),
+            _block_spec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, tq, lanes), jnp.float32),
+            _out_struct((bh, tq, d), q.dtype, (q, k, v)),
+            _out_struct((bh, tq, _LANES), jnp.float32, (q, k, v)),
         ],
-        scratch_shapes=scratch,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
         interpret=interpret,
-    )(q, k, v)
+    )(qs, ks, q, k, v)
     return o, lse[:, :, 0]
 
 
-def _blockwise_bwd(q, k, v, o, lse, g, *, scale, causal, block_k):
-    """dQ/dK/dV via per-k-block recompute from lse; all [BH, T, D] fp32."""
+def _blockwise_bwd(q, k, v, o, lse, q_start, k_start, g, g_lse,
+                   *, scale, causal, block_k):
+    """dQ/dK/dV via per-k-block recompute from lse; all [BH, T, D].
+
+    ``g_lse`` is the lse output's cotangent: d lse/d s is the normalized
+    probability row, so it folds into dS as ``p * g_lse`` (used by ring
+    attention's merge; zeros for plain attention).
+    """
     bh, tq, d = q.shape
     tk = k.shape[1]
     block_k = min(block_k, tk)
@@ -165,7 +201,8 @@ def _blockwise_bwd(q, k, v, o, lse, g, *, scale, causal, block_k):
     qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
     of, gf = o.astype(jnp.float32), g.astype(jnp.float32)
     delta = jnp.sum(of * gf, axis=-1, keepdims=True)  # [BH, Tq, 1]
-    qpos = jnp.arange(tq)
+    corr = g_lse.astype(jnp.float32)[..., None] - delta  # [BH, Tq, 1]
+    qpos = q_start + jnp.arange(tq)
 
     def body(j, carry):
         dq, dk, dv = carry
@@ -173,13 +210,15 @@ def _blockwise_bwd(q, k, v, o, lse, g, *, scale, causal, block_k):
         vb = lax.dynamic_slice_in_dim(vf, j * block_k, block_k, axis=1)
         s = jnp.einsum("bqd,bkd->bqk", qf, kb) * scale
         if causal:
-            kpos = j * block_k + jnp.arange(block_k)
+            kpos = k_start + j * block_k + jnp.arange(block_k)
             mask = kpos[None, :] <= qpos[:, None]
             s = jnp.where(mask[None], s, _NEG_INF)
-        p = jnp.exp(s - lse[..., None])  # [BH, Tq, block_k]
+        p = jnp.exp(s - lse[..., None])  # normalized probs [BH, Tq, block_k]
+        if causal:
+            p = jnp.where(s[...] > _MASK_THRESH, p, 0.0)
         dvb = jnp.einsum("bqk,bqd->bkd", p, gf)
         dp = jnp.einsum("bqd,bkd->bqk", gf, vb)
-        ds = p * (dp - delta) * scale
+        ds = p * (dp + corr) * scale
         dq = dq + jnp.einsum("bqk,bkd->bqd", ds, kb)
         dkb = jnp.einsum("bqk,bqd->bkd", ds, qf)
         dk = lax.dynamic_update_slice_in_dim(dk, dkb, j * block_k, axis=1)
@@ -195,33 +234,75 @@ def _blockwise_bwd(q, k, v, o, lse, g, *, scale, causal, block_k):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
-)
-def _flash_core(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, _ = _flash_fwd(
-        q, k, v, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, interpret=interpret,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_core(q, k, v, q_start, k_start, scale, causal, block_q, block_k,
+                interpret):
+    """(o, lse) with offsets as float32 scalars (zero-cotangent slots)."""
+    return _flash_fwd(
+        q, k, v, q_start.astype(jnp.int32), k_start.astype(jnp.int32),
+        scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
     )
-    return o
 
 
-def _flash_core_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_core_fwd(q, k, v, q_start, k_start, scale, causal, block_q,
+                    block_k, interpret):
     o, lse = _flash_fwd(
-        q, k, v, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, interpret=interpret,
+        q, k, v, q_start.astype(jnp.int32), k_start.astype(jnp.int32),
+        scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
     )
-    return o, (q, k, v, o, lse)
+    return (o, lse), (q, k, v, o, lse, q_start, k_start)
 
 
-def _flash_core_bwd(scale, causal, block_q, block_k, interpret, res, g):
-    q, k, v, o, lse = res
-    return _blockwise_bwd(
-        q, k, v, o, lse, g, scale=scale, causal=causal, block_k=block_k
+def _flash_core_bwd(scale, causal, block_q, block_k, interpret, res, cts):
+    q, k, v, o, lse, q_start, k_start = res
+    g, g_lse = cts
+    dq, dk, dv = _blockwise_bwd(
+        q, k, v, o, lse,
+        q_start.astype(jnp.int32), k_start.astype(jnp.int32), g, g_lse,
+        scale=scale, causal=causal, block_k=block_k,
     )
+    return dq, dk, dv, jnp.zeros_like(q_start), jnp.zeros_like(k_start)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention_with_lse(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    q_start=0,
+    k_start=0,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(out, lse) for q, k, v of shape ``[B, T, H, D]``; lse ``[B, H, T]``.
+
+    ``q_start``/``k_start`` are *global* sequence offsets (may be traced),
+    letting causal masking span sequence shards — one hop of ring attention
+    calls this with the rotating key-block offset.  Rows with no visible
+    keys return out=0, lse≈-1e30, which merge correctly.
+    """
+    if interpret is None:
+        interpret = _default_interpret()
+    b, tq, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+
+    def fold(x):  # [B, T, H, D] -> [B*H, T, D]
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    o, lse = _flash_core(
+        fold(q), fold(k), fold(v),
+        jnp.asarray(q_start, jnp.float32), jnp.asarray(k_start, jnp.float32),
+        scale, causal, block_q, block_k, interpret,
+    )
+    o = o.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    return o, lse.reshape(b, h, tq)
 
 
 def flash_attention(
@@ -239,18 +320,11 @@ def flash_attention(
     Drop-in for :func:`bluefog_tpu.models.transformer.dense_attention`
     (same layout/semantics, fp32 softmax), O(T·block) memory.
     """
-    if interpret is None:
-        interpret = _default_interpret()
-    b, tq, h, d = q.shape
-    scale = 1.0 / math.sqrt(d)
-
-    def fold(x):  # [B, T, H, D] -> [B*H, T, D]
-        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-
-    o = _flash_core(
-        fold(q), fold(k), fold(v), scale, causal, block_q, block_k, interpret
+    o, _ = flash_attention_with_lse(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
     )
-    return o.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    return o
 
 
 def make_flash_attention_fn(

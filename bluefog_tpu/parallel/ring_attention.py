@@ -25,7 +25,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["ring_attention", "make_ring_attention_fn"]
+__all__ = [
+    "ring_attention",
+    "ring_flash_attention",
+    "make_ring_attention_fn",
+]
 
 
 def ring_attention(
@@ -84,10 +88,76 @@ def ring_attention(
     return (o / denom).astype(q.dtype)
 
 
-def make_ring_attention_fn(axis_name: str, axis_size: int, causal: bool = True
-                           ) -> Callable:
+def ring_flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    axis_size: int,
+    *,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = None,
+) -> jnp.ndarray:
+    """Ring attention with the Pallas flash kernel as the per-hop compute.
+
+    Same semantics/layout as :func:`ring_attention`, but each hop runs
+    :func:`bluefog_tpu.kernels.flash_attention_with_lse` — MXU-blocked,
+    O(T_local·block) memory instead of materializing the [Tq, Tk] score
+    matrix — and hops merge by the logsumexp rule.  Differentiable end to
+    end (the kernel's VJP carries the lse cotangent the merge needs).
+
+    Note: when running the kernel in *interpret mode* (CPU testing), the
+    Pallas HLO interpreter is not vma-aware, so the enclosing
+    ``jax.shard_map`` needs ``check_vma=False``; compiled TPU execution has
+    no such restriction.
+    """
+    from bluefog_tpu.kernels import flash_attention_with_lse
+
+    n = axis_size
+    tq, tk = q.shape[1], k.shape[1]
+    idx = lax.axis_index(axis_name)
+    perm = tuple((i, (i + 1) % n) for i in range(n))
+
+    o = None
+    lse = None
+    kv = (k, v)
+    for step in range(n):
+        kb, vb = kv
+        j = (idx - step) % n  # global index of the key block held this step
+        o_s, lse_s = flash_attention_with_lse(
+            q, kb, vb,
+            q_start=idx * tq, k_start=j * tk,
+            causal=causal, block_q=block_q, block_k=block_k,
+            interpret=interpret,
+        )
+        o_s = o_s.astype(jnp.float32)
+        if o is None:
+            o, lse = o_s, lse_s
+        else:
+            m = jnp.maximum(lse, lse_s)
+            w_old = jnp.exp(lse - m)  # [B, H, T]
+            w_new = jnp.exp(lse_s - m)
+            denom = w_old + w_new  # >= 1 (or 2 for all-masked rows)
+            align = lambda w: w.transpose(0, 2, 1)[..., None]  # -> [B,T,H,1]
+            o = (align(w_old) * o + align(w_new) * o_s) / align(denom)
+            lse = m + jnp.log(denom)
+        if step != n - 1:
+            kv = lax.ppermute(kv, axis_name, perm)
+    return o.astype(q.dtype)
+
+
+def make_ring_attention_fn(axis_name: str, axis_size: int, causal: bool = True,
+                           *, flash: bool = False, **flash_kwargs) -> Callable:
     """attention_fn for ``models.transformer.LlamaLM``: plugs sequence-
-    parallel ring attention into the decoder blocks."""
+    parallel ring attention into the decoder blocks (``flash=True`` selects
+    the Pallas-kernel hop compute)."""
+    if flash:
+        return partial(
+            ring_flash_attention, axis_name=axis_name, axis_size=axis_size,
+            causal=causal, **flash_kwargs
+        )
     return partial(
         ring_attention, axis_name=axis_name, axis_size=axis_size, causal=causal
     )
