@@ -225,11 +225,9 @@ def _blockwise_bwd(q, k, v, o, lse, q_start, k_start, g, g_lse,
         dv = lax.dynamic_update_slice_in_dim(dv, dvb, j * block_k, axis=1)
         return dq, dk, dv
 
-    init = (
-        jnp.zeros((bh, tq, d), jnp.float32),
-        jnp.zeros((bh, tk, d), jnp.float32),
-        jnp.zeros((bh, tk, d), jnp.float32),
-    )
+    # derive inits from the operands so device-varying types (shard_map vma)
+    # match between the loop carry input and output
+    init = (qf * 0.0, kf * 0.0, vf * 0.0)
     dq, dk, dv = lax.fori_loop(0, num_k, body, init)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 

@@ -53,6 +53,11 @@ def main():
     parser.add_argument("--vocab", type=int, default=64)
     parser.add_argument("--lr", type=float, default=3e-3)
     parser.add_argument("--seq-parallel", action="store_true")
+    parser.add_argument(
+        "--attention", choices=["dense", "flash"], default="dense",
+        help="flash = Pallas flash-attention kernel "
+        "(ring-flash hops under --seq-parallel)",
+    )
     args = parser.parse_args()
 
     bf.init()
@@ -65,9 +70,15 @@ def main():
         run_seq_parallel(args, ctx, n, rng)
         return
 
+    attention_fn = None
+    if args.attention == "flash":
+        from bluefog_tpu.kernels import make_flash_attention_fn
+
+        attention_fn = make_flash_attention_fn()
     model = LlamaLM(
         vocab_size=args.vocab, hidden_size=args.hidden, num_layers=args.layers,
         num_heads=4, dff=args.hidden * 3, dtype=jnp.float32,
+        attention_fn=attention_fn,
     )
     ids0 = jnp.zeros((1, args.seq_len), jnp.int32)
     params0 = model.init(jax.random.PRNGKey(0), ids0)["params"]
@@ -130,10 +141,11 @@ def run_seq_parallel(args, ctx, n, rng):
     gives exact global attention; gossip mixes params between steps."""
     assert args.seq_len % n == 0
     tl = args.seq_len // n
+    use_flash = args.attention == "flash"
     model = LlamaLM(
         vocab_size=args.vocab, hidden_size=args.hidden, num_layers=args.layers,
         num_heads=4, dff=args.hidden * 3, dtype=jnp.float32,
-        attention_fn=make_ring_attention_fn(NODES_AXIS, n),
+        attention_fn=make_ring_attention_fn(NODES_AXIS, n, flash=use_flash),
     )
     ids0 = jnp.zeros((1, args.seq_len), jnp.int32)
     dense_twin = LlamaLM(
@@ -174,6 +186,8 @@ def run_seq_parallel(args, ctx, n, rng):
             in_specs=(P(), jax.tree_util.tree_map(lambda _: P(), opt_state),
                       P(None, NODES_AXIS)),
             out_specs=(P(), jax.tree_util.tree_map(lambda _: P(), opt_state), P()),
+            # pallas interpret mode (CPU) is not vma-aware
+            check_vma=not use_flash,
         )
     )
     stream = make_stream(rng, args.vocab, args.batch_size * args.seq_len * args.steps + 1)
