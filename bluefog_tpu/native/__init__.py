@@ -54,6 +54,22 @@ def get_lib() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
             return None
+        try:
+            _declare_abi(lib)
+        except AttributeError:
+            # stale .so from before a symbol was added: rebuild once
+            if not build(force=True):
+                return None
+            try:
+                lib = ctypes.CDLL(_LIB_PATH)
+                _declare_abi(lib)
+            except (OSError, AttributeError):
+                return None
+        _lib = lib
+        return _lib
+
+
+def _declare_abi(lib: ctypes.CDLL) -> None:
         # timeline ABI
         lib.bf_timeline_create.restype = ctypes.c_void_p
         lib.bf_timeline_create.argtypes = [ctypes.c_char_p]
@@ -86,5 +102,18 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int64),
         ]
-        _lib = lib
-        return _lib
+        # layout optimizer ABI
+        lib.bf_layout_anneal.restype = ctypes.c_double
+        lib.bf_layout_anneal.argtypes = [
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]

@@ -1,0 +1,106 @@
+"""ctypes wrapper over the native layout annealer (layout_optimizer.cc),
+with a pure-Python fallback implementing the same search."""
+
+from __future__ import annotations
+
+import ctypes
+import math
+import random
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bluefog_tpu.native import get_lib
+from bluefog_tpu.parallel.ici_map import hop_distance as _hop
+
+
+def anneal_layout(
+    coords: Sequence[Sequence[int]],
+    torus_shape: Sequence[int],
+    edges: Sequence[Tuple[int, int]],
+    weights: Optional[Sequence[float]] = None,
+    *,
+    init: Optional[Sequence[int]] = None,
+    iters: int = 20000,
+    seed: int = 0,
+) -> Tuple[List[int], float]:
+    """Best-found rank→position assignment and its weighted hop cost.
+
+    ``coords[p]`` is candidate position p's torus coordinate; ranks and
+    positions are both ``0..n-1``.  ``init`` seeds the search (identity by
+    default).  Uses the native annealer when available, else the Python
+    twin (same moves/cooling, deterministic for a given seed on each path).
+    """
+    n = len(coords)
+    nd = len(torus_shape)
+    if any(len(c) != nd for c in coords):
+        raise ValueError("coords dimensionality does not match torus_shape")
+    m = len(edges)
+    w = [1.0] * m if weights is None else list(weights)
+    if len(w) != m:
+        raise ValueError(f"{m} edges but {len(w)} weights")
+    assign = list(range(n)) if init is None else list(init)
+    if sorted(assign) != list(range(n)):
+        raise ValueError("init must be a permutation of 0..n-1")
+    for s, d in edges:
+        if not (0 <= s < n and 0 <= d < n) or s == d:
+            raise ValueError(f"invalid edge ({s}, {d})")
+
+    lib = get_lib()
+    if lib is not None:
+        c_coords = np.ascontiguousarray(coords, dtype=np.int64).reshape(n, nd)
+        c_shape = np.ascontiguousarray(torus_shape, dtype=np.int64)
+        c_src = np.ascontiguousarray([e[0] for e in edges], dtype=np.int64)
+        c_dst = np.ascontiguousarray([e[1] for e in edges], dtype=np.int64)
+        c_w = np.ascontiguousarray(w, dtype=np.float64)
+        c_assign = np.ascontiguousarray(assign, dtype=np.int64)
+        ip = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        cost = lib.bf_layout_anneal(
+            n, nd, ip(c_coords), ip(c_shape), m, ip(c_src), ip(c_dst),
+            c_w.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            iters, seed, ip(c_assign),
+        )
+        if cost < 0:
+            raise ValueError("native annealer rejected the input")
+        return c_assign.tolist(), float(cost)
+
+    # ---- pure-Python twin ----
+    pos = list(assign)
+    inc: List[List[int]] = [[] for _ in range(n)]
+    for e, (s, d) in enumerate(edges):
+        inc[s].append(e)
+        if d != s:
+            inc[d].append(e)
+
+    def edge_cost(e: int) -> float:
+        s, d = edges[e]
+        return w[e] * _hop(coords[pos[s]], coords[pos[d]], torus_shape)
+
+    cost = sum(edge_cost(e) for e in range(m))
+    best, best_cost = list(pos), cost
+    if n < 2 or m == 0 or iters == 0:
+        return best, best_cost
+
+    rng = random.Random(seed)
+    t0 = max(cost / max(m, 1), 1e-9)
+    decay = (t0 * 1e-3 / t0) ** (1.0 / iters)
+    temp = t0
+    for _ in range(iters):
+        r1, r2 = rng.randrange(n), rng.randrange(n)
+        temp *= decay
+        if r1 == r2:
+            continue
+        touched = inc[r1] + [
+            e for e in inc[r2] if edges[e][0] != r1 and edges[e][1] != r1
+        ]
+        before = sum(edge_cost(e) for e in touched)
+        pos[r1], pos[r2] = pos[r2], pos[r1]
+        after = sum(edge_cost(e) for e in touched)
+        delta = after - before
+        if delta <= 0 or rng.random() < math.exp(-delta / temp):
+            cost += delta
+            if cost < best_cost:
+                best_cost, best = cost, list(pos)
+        else:
+            pos[r1], pos[r2] = pos[r2], pos[r1]
+    return best, best_cost

@@ -103,8 +103,13 @@ def neighbor_allreduce(
         else:
             sw = jnp.asarray(self_weight, dtype=wdt)
         acc = a.astype(wdt) * sw
+        # permute in the NARROWER of storage/average dtype: bf16 params with
+        # fp32 accumulation send 2 bytes/elem over ICI (the neighbor's exact
+        # stored value either way), and an explicit narrow average_dtype
+        # still shrinks the wire for wide params
+        wire = a if a.dtype.itemsize <= jnp.dtype(wdt).itemsize else a.astype(wdt)
         for cls in plan.classes:
-            recvd = lax.ppermute(a.astype(wdt), axis_name, cls.perm)
+            recvd = lax.ppermute(wire, axis_name, cls.perm).astype(wdt)
             w = jnp.asarray(cls.recv_weights, dtype=wdt)[idx]
             acc = acc + w * recvd
         return acc
@@ -187,7 +192,7 @@ def pairwise_gossip(
 
     def g(a):
         wdt = _weight_dtype(a)
-        recvd = lax.ppermute(a.astype(wdt), axis_name, send_to)
+        recvd = lax.ppermute(a, axis_name, send_to).astype(wdt)
         idx = lax.axis_index(axis_name)
         mask = jnp.asarray(mask_host, dtype=wdt)[idx]
         keep = self_weight + (1.0 - mask) * peer_weight

@@ -38,9 +38,11 @@ __all__ = [
     "snake_order",
     "device_coords",
     "order_devices_for_ring",
+    "order_devices_for_topology",
     "hop_distance",
     "plan_hop_cost",
     "assignment_from_coords",
+    "optimize_assignment",
 ]
 
 
@@ -109,6 +111,73 @@ def order_devices_for_ring(devices, torus_shape: Optional[Sequence[int]] = None)
     if torus_shape is None:
         torus_shape = tuple(max(c[d] for c in coords) + 1 for d in range(len(coords[0])))
     order = assignment_from_coords(coords, torus_shape)
+    return [devices[i] for i in order]
+
+
+def _topology_edges(topo):
+    """Directed non-self edges + weights of a networkx digraph."""
+    edges, weights = [], []
+    for s, d, data in topo.edges(data=True):
+        if s == d:
+            continue
+        edges.append((int(s), int(d)))
+        weights.append(float(data.get("weight", 1.0)))
+    return edges, weights
+
+
+def optimize_assignment(
+    topo,
+    coords: Sequence[Coord],
+    torus_shape: Sequence[int],
+    *,
+    iters: int = 20000,
+    seed: int = 0,
+):
+    """Annealed rank→position assignment for an arbitrary weighted digraph.
+
+    Seeds the search with the snake order (so the result is never worse than
+    the heuristic) and runs the native simulated annealer
+    (``native/layout_optimizer.cc``; pure-Python twin as fallback) to
+    minimize Σ weight·hops over the topology's edges.  Returns
+    ``(order, cost)`` where ``order[r]`` indexes ``coords``.
+    """
+    from bluefog_tpu.native.layout_native import anneal_layout
+
+    try:
+        init = assignment_from_coords(coords, torus_shape)
+    except ValueError:
+        init = None  # coords don't tile the torus; start from identity
+    edges, weights = _topology_edges(topo)
+    return anneal_layout(
+        coords, torus_shape, edges, weights, init=init, iters=iters, seed=seed
+    )
+
+
+def order_devices_for_topology(
+    devices,
+    topo,
+    torus_shape: Optional[Sequence[int]] = None,
+    *,
+    iters: int = 20000,
+    seed: int = 0,
+):
+    """Reorder ``devices`` to minimize the topology's weighted ICI hop cost.
+
+    The general-graph sibling of :func:`order_devices_for_ring`: pass the
+    result to ``bluefog_tpu.init(devices=...)`` before ``set_topology``.
+    Falls back to the given order when physical coords are unavailable
+    (CPU simulation).
+    """
+    coords = device_coords(devices)
+    if coords is None:
+        return list(devices)
+    if torus_shape is None:
+        torus_shape = tuple(
+            max(c[d] for c in coords) + 1 for d in range(len(coords[0]))
+        )
+    order, _ = optimize_assignment(
+        topo, coords, torus_shape, iters=iters, seed=seed
+    )
     return [devices[i] for i in order]
 
 
