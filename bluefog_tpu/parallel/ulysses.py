@@ -1,0 +1,105 @@
+"""Ulysses sequence parallelism: all-to-all head/sequence re-sharding.
+
+No sibling in the reference (it predates long-context work — SURVEY.md
+§5.7); together with ``parallel.ring_attention`` this gives the rebuild
+both public long-context strategies.  The algorithm is DeepSpeed-Ulysses
+(Jacobs et al., arXiv:2309.14509): inputs arrive sharded over the
+*sequence* (each device holds ``[B, T_local, H, D]``); one
+``lax.all_to_all`` per operand re-shards them over *heads*
+(``[B, T_global, H_local, D]``), every device then runs ordinary full-
+sequence attention on its own head slice, and one final ``all_to_all``
+restores sequence sharding.
+
+Trade-off vs ring attention (why both exist):
+
+- Ulysses: 4 all-to-alls moving ``O(B·T·H·D / n)`` per device total —
+  bandwidth *decreases* with mesh size and the attention itself is a
+  single dense/flash call (best MXU utilization).  But the head count must
+  be divisible by the axis size, and peak activation memory holds the full
+  sequence for ``H/n`` heads.
+- Ring: ``n-1`` neighbor hops riding single ICI links, O(T_local) memory,
+  any head count — but the per-hop blockwise compute is smaller and the
+  softmax runs as an online recurrence.
+
+Short sequences / many heads → Ulysses; extreme lengths / few heads →
+ring.  Both plug into the model family via the same ``attention_fn`` slot.
+
+Layout: per-device ``q, k, v: [B, T_local, H, D]``; the global sequence is
+``axis_size * T_local`` in rank order along ``axis_name`` (identical to
+``ring_attention``, so they are drop-in interchangeable).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ulysses_attention", "make_ulysses_attention_fn"]
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    axis_size: int,
+    *,
+    causal: bool = True,
+    flash: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = None,
+) -> jnp.ndarray:
+    """Exact attention across sequence shards via head re-sharding.
+
+    q, k, v: [B, T_local, H, D] (this device's sequence block); H must be
+    divisible by ``axis_size``.  Returns [B, T_local, H, D] in q's dtype.
+    ``flash=True`` runs the Pallas flash kernel on the gathered sequence.
+    """
+    n = axis_size
+    H = q.shape[2]
+    if H % n != 0:
+        raise ValueError(
+            f"ulysses_attention needs num_heads ({H}) divisible by the "
+            f"sequence axis size ({n}); use ring_attention otherwise"
+        )
+
+    # [B, T_local, H, D] -> [B, T_global, H/n, D].  all_to_all concatenates
+    # received blocks in rank order along the sequence axis, which IS the
+    # global order because rank i holds sequence block i.
+    reshard = partial(lax.all_to_all, axis_name=axis_name,
+                      split_axis=2, concat_axis=1, tiled=True)
+    qg, kg, vg = reshard(q), reshard(k), reshard(v)
+
+    if flash:
+        from bluefog_tpu.kernels import flash_attention
+
+        out = flash_attention(
+            qg, kg, vg, causal=causal,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+    else:
+        from bluefog_tpu.models.transformer import dense_attention
+
+        out = dense_attention(qg, kg, vg, causal=causal, dtype=q.dtype)
+
+    # [B, T_global, H/n, D] -> [B, T_local, H, D]
+    return lax.all_to_all(
+        out.astype(q.dtype), axis_name=axis_name,
+        split_axis=1, concat_axis=2, tiled=True,
+    )
+
+
+def make_ulysses_attention_fn(axis_name: str, axis_size: int,
+                              causal: bool = True, *, flash: bool = False,
+                              **flash_kwargs) -> Callable:
+    """attention_fn for ``models.transformer.LlamaLM``: plugs Ulysses
+    sequence parallelism into the decoder blocks (same slot and layout as
+    ``make_ring_attention_fn`` — interchangeable)."""
+    return partial(
+        ulysses_attention, axis_name=axis_name, axis_size=axis_size,
+        causal=causal, flash=flash, **flash_kwargs
+    )

@@ -1,0 +1,159 @@
+"""Ulysses all-to-all sequence parallelism must be EXACT vs single-device
+softmax attention over the full sequence, and drop-in interchangeable with
+ring attention (same [B, T_local, H, D] layout on the 8-device mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import bluefog_tpu as bf
+from bluefog_tpu.core.basics import NODES_AXIS
+from bluefog_tpu.models.transformer import dense_attention
+from bluefog_tpu.parallel.ulysses import ulysses_attention
+
+SIZE = 8
+
+
+@pytest.fixture(autouse=True)
+def fresh_context(devices):
+    bf.init()
+    yield
+    bf.shutdown()
+
+
+def _qkv(rng, B=2, T=32, H=8, D=8):
+    ks = jax.random.split(rng, 3)
+    mk = lambda k: jax.random.normal(k, (B, T, H, D), jnp.float32)
+    return mk(ks[0]), mk(ks[1]), mk(ks[2])
+
+
+def _spmd(fn, mesh):
+    return jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=P(None, NODES_AXIS), out_specs=P(None, NODES_AXIS),
+        )
+    )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_dense(causal):
+    from bluefog_tpu.core import basics
+
+    mesh = basics.context().mesh
+    q, k, v = _qkv(jax.random.PRNGKey(0))
+    ref = dense_attention(q, k, v, causal=causal)
+    f = _spmd(
+        lambda q, k, v: ulysses_attention(q, k, v, NODES_AXIS, SIZE, causal=causal),
+        mesh,
+    )
+    np.testing.assert_allclose(np.asarray(f(q, k, v)), np.asarray(ref), atol=2e-5)
+
+
+def test_ulysses_matches_ring():
+    """Same layout, same answer: the two SP strategies are interchangeable."""
+    from bluefog_tpu.core import basics
+    from bluefog_tpu.parallel.ring_attention import ring_attention
+
+    mesh = basics.context().mesh
+    q, k, v = _qkv(jax.random.PRNGKey(3))
+    ring = _spmd(
+        lambda q, k, v: ring_attention(q, k, v, NODES_AXIS, SIZE, causal=True),
+        mesh,
+    )(q, k, v)
+    uly = _spmd(
+        lambda q, k, v: ulysses_attention(q, k, v, NODES_AXIS, SIZE, causal=True),
+        mesh,
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(uly), np.asarray(ring), atol=2e-5)
+
+
+def test_ulysses_grad_matches_dense():
+    from bluefog_tpu.core import basics
+
+    mesh = basics.context().mesh
+    q, k, v = _qkv(jax.random.PRNGKey(4), B=1, T=16, H=8, D=4)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+
+    gref = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+
+    def loss_spmd(q, k, v):
+        out = ulysses_attention(q, k, v, NODES_AXIS, SIZE, causal=True)
+        return jax.lax.psum(jnp.sum(out**2), NODES_AXIS)
+
+    g = jax.jit(
+        jax.shard_map(
+            jax.grad(loss_spmd, argnums=(0, 1, 2)), mesh=mesh,
+            in_specs=P(None, NODES_AXIS), out_specs=P(None, NODES_AXIS),
+        )
+    )(q, k, v)
+    for got, ref in zip(g, gref):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=5e-5)
+
+
+def test_ulysses_flash_matches_dense():
+    from bluefog_tpu.core import basics
+
+    mesh = basics.context().mesh
+    q, k, v = _qkv(jax.random.PRNGKey(5))
+    ref = dense_attention(q, k, v, causal=True)
+    f = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ulysses_attention(
+                q, k, v, NODES_AXIS, SIZE, causal=True,
+                flash=True, block_q=16, block_k=16, interpret=True,
+            ),
+            mesh=mesh,
+            in_specs=P(None, NODES_AXIS), out_specs=P(None, NODES_AXIS),
+            check_vma=False,
+        )
+    )
+    np.testing.assert_allclose(np.asarray(f(q, k, v)), np.asarray(ref), atol=2e-4)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    with pytest.raises(ValueError, match="divisible"):
+        q = jnp.ones((1, 4, 2, 4))  # H=2 < n=8
+        ulysses_attention(q, q, q, NODES_AXIS, SIZE)
+
+
+def test_llama_with_ulysses_matches_dense_path():
+    from bluefog_tpu.core import basics
+    from bluefog_tpu.models.transformer import LlamaLM
+    from bluefog_tpu.parallel.ulysses import make_ulysses_attention_fn
+
+    mesh = basics.context().mesh
+    V, T, Dm = 64, 32, 32
+    dense_model = LlamaLM(
+        vocab_size=V, hidden_size=Dm, num_layers=2, num_heads=8, dff=64,
+        dtype=jnp.float32,
+    )
+    ids = jax.random.randint(jax.random.PRNGKey(2), (2, T), 0, V)
+    variables = dense_model.init(jax.random.PRNGKey(0), ids)
+    ref = dense_model.apply(variables, ids)
+
+    uly_model = LlamaLM(
+        vocab_size=V, hidden_size=Dm, num_layers=2, num_heads=8, dff=64,
+        dtype=jnp.float32,
+        attention_fn=make_ulysses_attention_fn(NODES_AXIS, SIZE),
+    )
+
+    def fwd(variables, ids):
+        tl = T // SIZE
+        idx = jax.lax.axis_index(NODES_AXIS)
+        positions = idx * tl + jnp.arange(tl)
+        return uly_model.apply(variables, ids, positions=positions)
+
+    f = jax.jit(
+        jax.shard_map(
+            fwd, mesh=mesh,
+            in_specs=(P(), P(None, NODES_AXIS)),
+            out_specs=P(None, NODES_AXIS),
+        )
+    )
+    np.testing.assert_allclose(np.asarray(f(variables, ids)), np.asarray(ref),
+                               atol=3e-4)
