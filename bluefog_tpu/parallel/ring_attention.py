@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from bluefog_tpu.parallel._util import resolve_axis_size
+
 __all__ = [
     "ring_attention",
     "ring_flash_attention",
@@ -46,7 +48,7 @@ def ring_attention(
     q, k, v: [B, T_local, H, D] (this device's sequence block).
     Returns [B, T_local, H, D] in q's dtype.
     """
-    n = axis_size
+    n = resolve_axis_size(axis_name, axis_size)
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale = 1.0 / math.sqrt(D)
@@ -115,7 +117,7 @@ def ring_flash_attention(
     """
     from bluefog_tpu.kernels import flash_attention_with_lse
 
-    n = axis_size
+    n = resolve_axis_size(axis_name, axis_size)
     tq, tk = q.shape[1], k.shape[1]
     idx = lax.axis_index(axis_name)
     perm = tuple((i, (i + 1) % n) for i in range(n))

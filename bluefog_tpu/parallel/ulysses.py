@@ -37,6 +37,8 @@ from typing import Callable
 import jax.numpy as jnp
 from jax import lax
 
+from bluefog_tpu.parallel._util import resolve_axis_size
+
 __all__ = ["ulysses_attention", "make_ulysses_attention_fn"]
 
 
@@ -59,7 +61,7 @@ def ulysses_attention(
     divisible by ``axis_size``.  Returns [B, T_local, H, D] in q's dtype.
     ``flash=True`` runs the Pallas flash kernel on the gathered sequence.
     """
-    n = axis_size
+    n = resolve_axis_size(axis_name, axis_size)
     H = q.shape[2]
     if H % n != 0:
         raise ValueError(
@@ -69,10 +71,21 @@ def ulysses_attention(
 
     # [B, T_local, H, D] -> [B, T_global, H/n, D].  all_to_all concatenates
     # received blocks in rank order along the sequence axis, which IS the
-    # global order because rank i holds sequence block i.
-    reshard = partial(lax.all_to_all, axis_name=axis_name,
-                      split_axis=2, concat_axis=1, tiled=True)
-    qg, kg, vg = reshard(q), reshard(k), reshard(v)
+    # global order because rank i holds sequence block i.  When q/k/v agree
+    # in shape and dtype (the training hot path) they ride ONE stacked
+    # collective (axes shift by one under the leading stack axis); otherwise
+    # (e.g. causal=False cross-attention with Tk != Tq, or narrower k/v
+    # dtypes) each reshards independently.
+    if q.shape == k.shape == v.shape and q.dtype == k.dtype == v.dtype:
+        qkv = lax.all_to_all(
+            jnp.stack((q, k, v)), axis_name=axis_name,
+            split_axis=3, concat_axis=2, tiled=True,
+        )
+        qg, kg, vg = qkv[0], qkv[1], qkv[2]
+    else:
+        reshard = partial(lax.all_to_all, axis_name=axis_name,
+                          split_axis=2, concat_axis=1, tiled=True)
+        qg, kg, vg = reshard(q), reshard(k), reshard(v)
 
     if flash:
         from bluefog_tpu.kernels import flash_attention
