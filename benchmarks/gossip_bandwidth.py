@@ -1,0 +1,105 @@
+"""Gossip bandwidth benchmark — the second BASELINE.json tracked metric
+("win_put gossip bandwidth GB/s"; SURVEY.md §7 stage 6 names this file).
+
+Measures the one-sided-emulation hot path: repeated ``win_put`` exchanges of
+a large tensor along the installed topology, reporting aggregate bytes moved
+across the mesh per second.  Bytes counted are payload bytes actually put on
+the wire: per exchange, every rank sends its payload once per out-edge
+(``lax.ppermute`` per shift class — the grouped-send/recv twin of the
+reference's per-neighbor ``MPI_Put`` [U], SURVEY.md §2.4).
+
+A ``neighbor_allreduce`` phase runs for comparison (same wire pattern, no
+mailbox), so the window emulation's overhead over the raw collective is
+visible.
+
+Run (CPU mesh): JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python benchmarks/gossip_bandwidth.py --mb 4 --iters 5
+Run (TPU):      python benchmarks/gossip_bandwidth.py
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+vs_baseline is win_put bandwidth / neighbor_allreduce bandwidth.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import jax
+
+# honor JAX_PLATFORMS even where a sitecustomize force-registers another
+# backend (the config update wins over plugin registration; cf. tests/conftest)
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bluefog_tpu as bf
+from bluefog_tpu import topology_util
+from bluefog_tpu.core import basics
+from bench import _sync  # the tunneled-TPU sync workaround, one copy only
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mb", type=float, default=64.0,
+                        help="payload megabytes per rank")
+    parser.add_argument("--iters", type=int, default=20)
+    parser.add_argument("--warmup", type=int, default=3)
+    parser.add_argument("--topology", default="exp2", choices=["exp2", "ring"])
+    args = parser.parse_args()
+
+    bf.init()
+    n = bf.size()
+    topo = (topology_util.ExponentialTwoGraph(n) if args.topology == "exp2"
+            else topology_util.RingGraph(n))
+    bf.set_topology(topo)
+    plan = basics.context().plan
+
+    elems = max(int(args.mb * 1e6 / 4), 1)
+    x = jnp.ones((n, elems), jnp.float32)
+    payload_bytes = elems * 4
+    # one send per out-edge per exchange, summed over ranks
+    edges = sum(len(cls.perm) for cls in plan.classes)
+
+    def timed(fn):
+        """fn() -> device array the iteration's work flows into."""
+        out = fn()  # always at least one un-timed call to trigger compile
+        for _ in range(max(args.warmup - 1, 0)):
+            out = fn()
+        _sync(out)
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = fn()
+        _sync(out)
+        return (time.perf_counter() - t0) / args.iters
+
+    # --- win_put phase (the metric) ---
+    bf.win_create(x, "gossip_bw")
+
+    def put_update():
+        bf.win_put(x, "gossip_bw")
+        return bf.win_update("gossip_bw", clone=True)
+
+    t_put = timed(put_update)
+    bf.win_free("gossip_bw")
+
+    # --- raw neighbor_allreduce phase (the comparison point) ---
+    t_nar = timed(lambda: bf.neighbor_allreduce(x))
+
+    gbs_put = edges * payload_bytes / t_put / 1e9
+    gbs_nar = edges * payload_bytes / t_nar / 1e9
+    print(json.dumps({
+        "metric": f"win_put gossip bandwidth ({args.topology}, {n} ranks, "
+                  f"{args.mb:g} MB payload)",
+        "value": round(gbs_put, 3),
+        "unit": "GB/s aggregate",
+        "vs_baseline": round(gbs_put / gbs_nar, 4) if gbs_nar else 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+"""ResNet-50 decentralized-SGD throughput benchmark (SURVEY.md §7 stage 6
+names this file).  The implementation lives in the repo-root ``bench.py`` —
+the driver's entry point — so the two can never drift; this wrapper exists
+at the surveyed path.
+
+Run: python benchmarks/resnet50.py   (env knobs: BENCH_BATCH, BENCH_STEPS,
+BENCH_WARMUP, BENCH_BUDGET_S — see bench.py)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import main
+
+if __name__ == "__main__":
+    main()

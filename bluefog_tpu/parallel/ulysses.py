@@ -81,7 +81,7 @@ def ulysses_attention(
             jnp.stack((q, k, v)), axis_name=axis_name,
             split_axis=3, concat_axis=2, tiled=True,
         )
-        qg, kg, vg = qkv[0], qkv[1], qkv[2]
+        qg, kg, vg = qkv
     else:
         reshard = partial(lax.all_to_all, axis_name=axis_name,
                           split_axis=2, concat_axis=1, tiled=True)

@@ -325,6 +325,17 @@ def win_get_nonblocking(name: str, src_weights: WeightsArg = None):
     return Handle(_win(name).mail)
 
 
+def _combine(self_tensor, mail, p_self, p_mail, wmat, swvec, *, wdt, with_p):
+    """Fused local weighted combine (jitted via the context cache)."""
+    size, maxd = wmat.shape
+    extra = (1,) * (self_tensor.ndim - 1)
+    w = wmat.astype(wdt).reshape((size, maxd) + extra)
+    sw = swvec.astype(wdt).reshape((size,) + extra)
+    combined = sw * self_tensor.astype(wdt) + (w * mail.astype(wdt)).sum(axis=1)
+    new_p = swvec * p_self + (wmat * p_mail).sum(axis=1) if with_p else p_self
+    return combined.astype(self_tensor.dtype), new_p
+
+
 def win_update(
     name: str,
     self_weight: Optional[Union[float, Sequence[float]]] = None,
@@ -367,18 +378,26 @@ def win_update(
                 swvec[d] = float(self_weight[d])
 
         wdt = win.dtype if jnp.issubdtype(win.dtype, jnp.inexact) else jnp.float32
-        w = jnp.asarray(wmat, dtype=wdt).reshape(
-            (size, maxd) + (1,) * (len(win.shape) - 1)
+        with_p = ctx.win_associated_p_enabled
+        # one fused kernel per (shape, dtype, with_p); weights are traced
+        # args so every weight value shares the compile
+        key = ("win_update", with_p, win.dtype, win.shape[1:], maxd)
+        f = ctx.jit_cache(
+            key, lambda: jax.jit(_combine, static_argnames=("wdt", "with_p"))
         )
-        sw = jnp.asarray(swvec, dtype=wdt).reshape((size,) + (1,) * (len(win.shape) - 1))
-        combined = sw * win.self_tensor.astype(wdt) + (
-            w * win.mail.astype(wdt)
-        ).sum(axis=1)
-        win.self_tensor = combined.astype(win.dtype)
-        if ctx.win_associated_p_enabled:
-            win.p_self = jnp.asarray(swvec) * win.p_self + (
-                jnp.asarray(wmat) * win.p_mail
-            ).sum(axis=1)
+        combined, p_self = f(
+            win.self_tensor,
+            win.mail,
+            win.p_self,
+            win.p_mail,
+            jnp.asarray(wmat),
+            jnp.asarray(swvec),
+            wdt=wdt,
+            with_p=with_p,
+        )
+        win.self_tensor = combined
+        if with_p:
+            win.p_self = p_self
         if reset:
             win.mail = jnp.zeros_like(win.mail)
             win.p_mail = jnp.zeros_like(win.p_mail)
