@@ -75,6 +75,7 @@ from bluefog_tpu.windows import (
     win_mutex,
     get_win_version,
     win_associated_p,
+    win_set_exposed,
     turn_on_win_ops_with_associated_p,
     turn_off_win_ops_with_associated_p,
 )

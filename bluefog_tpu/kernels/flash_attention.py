@@ -57,6 +57,16 @@ def _block_spec(shape, index_map):
     return pl.BlockSpec(shape, index_map, memory_space=pltpu.VMEM)
 
 
+def _fit_block(t, b):
+    """Largest power-of-two shrink of ``b`` that divides sequence length
+    ``t`` (capped at ``t`` itself), so default block sizes adapt to short or
+    odd shards instead of raising."""
+    b = min(b, t)
+    while t % b and b > 1:
+        b = max(b // 2, 1)
+    return b
+
+
 def _out_struct(shape, dtype, operands):
     """ShapeDtypeStruct whose varying-mesh-axes set is the union of the
     operands' (required under shard_map's vma checking; empty outside)."""
@@ -82,13 +92,19 @@ def _fwd_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         acc[...] = jnp.zeros_like(acc)
 
     def _body():
-        q = q_ref[0].astype(jnp.float32)  # [block_q, D]
-        k = k_ref[0].astype(jnp.float32)  # [block_k, D]
-        v = v_ref[0].astype(jnp.float32)  # [block_k, D]
+        # operands stay in their storage dtype (bf16 on TPU — full-rate MXU
+        # passes); fp32 happens only in the accumulator via
+        # preferred_element_type.  Casting to fp32 first would force the
+        # MXU's slow fp32 path and make the kernel slower than dense XLA.
+        q = q_ref[0]  # [block_q, D]
+        k = k_ref[0]  # [block_k, D]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [block_q, block_k]
+        ) * scale  # [block_q, block_k] fp32
         if causal:
+            # unconditional element mask: a lax.cond skipping it for
+            # fully-visible blocks measured *slower* (Mosaic control-flow
+            # overhead exceeds the iota/where VPU cost)
             qpos = qs_ref[0, 0] + iq * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
@@ -107,7 +123,8 @@ def _fwd_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             p = jnp.where(s > _MASK_THRESH, p, 0.0)
         l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc[...] = acc[...] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -137,13 +154,8 @@ def _flash_fwd(q, k, v, q_start, k_start, *, scale, causal, block_q, block_k,
     """
     bh, tq, d = q.shape
     tk = k.shape[1]
-    block_q = min(block_q, tq)
-    block_k = min(block_k, tk)
-    if tq % block_q or tk % block_k:
-        raise ValueError(
-            f"sequence lengths ({tq}, {tk}) must divide by blocks "
-            f"({block_q}, {block_k})"
-        )
+    block_q = _fit_block(tq, block_q)
+    block_k = _fit_block(tk, block_k)
     num_q, num_k = tq // block_q, tk // block_k
 
     qs = jnp.asarray(q_start, jnp.int32).reshape(1, 1)
@@ -196,19 +208,22 @@ def _blockwise_bwd(q, k, v, o, lse, q_start, k_start, g, g_lse,
     """
     bh, tq, d = q.shape
     tk = k.shape[1]
-    block_k = min(block_k, tk)
+    block_k = _fit_block(tk, block_k)  # must cover tk exactly, like forward
     num_k = tk // block_k
-    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
-    of, gf = o.astype(jnp.float32), g.astype(jnp.float32)
-    delta = jnp.sum(of * gf, axis=-1, keepdims=True)  # [BH, Tq, 1]
+    # matmul operands stay in their storage dtype (bf16 on TPU) with fp32
+    # accumulators — casting up first would force the MXU's slow fp32 path;
+    # only elementwise softmax math runs in fp32
+    f32 = functools.partial(jnp.einsum, preferred_element_type=jnp.float32)
+    delta = jnp.sum(o.astype(jnp.float32) * g.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [BH, Tq, 1]
     corr = g_lse.astype(jnp.float32)[..., None] - delta  # [BH, Tq, 1]
     qpos = q_start + jnp.arange(tq)
 
     def body(j, carry):
         dq, dk, dv = carry
-        kb = lax.dynamic_slice_in_dim(kf, j * block_k, block_k, axis=1)
-        vb = lax.dynamic_slice_in_dim(vf, j * block_k, block_k, axis=1)
-        s = jnp.einsum("bqd,bkd->bqk", qf, kb) * scale
+        kb = lax.dynamic_slice_in_dim(k, j * block_k, block_k, axis=1)
+        vb = lax.dynamic_slice_in_dim(v, j * block_k, block_k, axis=1)
+        s = f32("bqd,bkd->bqk", q, kb) * scale
         if causal:
             kpos = k_start + j * block_k + jnp.arange(block_k)
             mask = kpos[None, :] <= qpos[:, None]
@@ -216,18 +231,18 @@ def _blockwise_bwd(q, k, v, o, lse, q_start, k_start, g, g_lse,
         p = jnp.exp(s - lse[..., None])  # normalized probs [BH, Tq, block_k]
         if causal:
             p = jnp.where(s[...] > _MASK_THRESH, p, 0.0)
-        dvb = jnp.einsum("bqk,bqd->bkd", p, gf)
-        dp = jnp.einsum("bqd,bkd->bqk", gf, vb)
-        ds = p * (dp + corr) * scale
-        dq = dq + jnp.einsum("bqk,bkd->bqd", ds, kb)
-        dkb = jnp.einsum("bqk,bqd->bkd", ds, qf)
+        dvb = f32("bqk,bqd->bkd", p.astype(g.dtype), g)
+        dp = f32("bqd,bkd->bqk", g, vb)
+        ds = (p * (dp + corr) * scale).astype(q.dtype)
+        dq = dq + f32("bqk,bkd->bqd", ds, kb)
+        dkb = f32("bqk,bqd->bkd", ds, q)
         dk = lax.dynamic_update_slice_in_dim(dk, dkb, j * block_k, axis=1)
         dv = lax.dynamic_update_slice_in_dim(dv, dvb, j * block_k, axis=1)
         return dq, dk, dv
 
-    # derive inits from the operands so device-varying types (shard_map vma)
-    # match between the loop carry input and output
-    init = (qf * 0.0, kf * 0.0, vf * 0.0)
+    # fp32 carries derived from the operands so device-varying types
+    # (shard_map vma) match between the loop carry input and output
+    init = tuple(x.astype(jnp.float32) * 0.0 for x in (q, k, v))
     dq, dk, dv = lax.fori_loop(0, num_k, body, init)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
@@ -275,8 +290,8 @@ def flash_attention_with_lse(
     q_start=0,
     k_start=0,
     causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 256,
+    block_k: int = 256,
     interpret: Optional[bool] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(out, lse) for q, k, v of shape ``[B, T, H, D]``; lse ``[B, H, T]``.
@@ -309,8 +324,8 @@ def flash_attention(
     v: jnp.ndarray,
     *,
     causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 256,
+    block_k: int = 256,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Memory-efficient exact attention; q, k, v: ``[B, T, H, D]``.
@@ -327,8 +342,8 @@ def flash_attention(
 
 def make_flash_attention_fn(
     causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 256,
+    block_k: int = 256,
     interpret: Optional[bool] = None,
 ) -> Callable:
     """``attention_fn`` for :class:`bluefog_tpu.models.transformer.LlamaLM`."""

@@ -28,10 +28,11 @@ from __future__ import annotations
 
 import enum
 import functools
-from typing import Any, Callable, NamedTuple, Optional
+from typing import Any, Callable, Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import PartitionSpec as P
 
@@ -339,6 +340,22 @@ class DistributedGradientAllreduceOptimizer(_EagerDistributedOptimizer):
         return gradient_allreduce_spmd(self.base, NODES_AXIS, self.k)
 
 
+def _pack_leaves(leaves):
+    """Rank-major leaves [size, ...] -> one [size, total_elems] buffer."""
+    size = leaves[0].shape[0]
+    return jnp.concatenate([l.reshape(size, -1) for l in leaves], axis=1)
+
+
+def _unpack_leaves(buf, *, shapes):
+    """Inverse of :func:`_pack_leaves` for the given leaf shapes."""
+    sizes = [int(np.prod(s[1:])) for s in shapes]
+    offsets = np.cumsum([0] + sizes)
+    return [
+        buf[:, offsets[i]:offsets[i + 1]].reshape(shapes[i])
+        for i in range(len(shapes))
+    ]
+
+
 class DistributedWinPutOptimizer:
     """Asynchronous win-put optimizer (reference
     ``bf.DistributedWinPutOptimizer`` [U]): each step does a local adapt,
@@ -354,17 +371,38 @@ class DistributedWinPutOptimizer:
         base_optimizer: optax.GradientTransformation,
         window_prefix: str = "winput_opt",
         num_steps_per_communication: int = 1,
+        fuse: bool = True,
     ):
         self.base = base_optimizer
         self.prefix = window_prefix
         self.k = int(num_steps_per_communication)
+        self.fuse = fuse
         self._step_count = 0
         self._created = False
+        self._groups = None  # fused mode: [(leaf_indices, leaf_shapes)]
 
     def init(self, params):
         leaves = jax.tree_util.tree_leaves(params)
-        for i, leaf in enumerate(leaves):
-            windows.win_create(leaf, f"{self.prefix}.{i}")
+        if self.fuse:
+            # Tensor fusion, TPU-style: the reference coalesced small tensors
+            # into its fusion buffer on the background thread
+            # (BLUEFOG_FUSION_THRESHOLD, SURVEY.md §3.2); here all leaves of a
+            # dtype pack into ONE rank-major window so a whole model's
+            # win_put+win_update is two dispatches instead of 2 x num_leaves.
+            by_dtype: Dict[Any, list] = {}
+            for i, leaf in enumerate(leaves):
+                by_dtype.setdefault(jnp.asarray(leaf).dtype, []).append(i)
+            self._groups = []
+            for g, (_, idxs) in enumerate(
+                sorted(by_dtype.items(), key=lambda kv: str(kv[0]))
+            ):
+                shapes = tuple(tuple(leaves[i].shape) for i in idxs)
+                packed = _pack_leaves([leaves[i] for i in idxs])
+                windows.win_create(packed, f"{self.prefix}.fused{g}")
+                self._groups.append((idxs, shapes))
+        else:
+            for i, leaf in enumerate(leaves):
+                windows.win_create(leaf, f"{self.prefix}.{i}")
         self._created = True
         return self.base.init(params)
 
@@ -393,12 +431,29 @@ class DistributedWinPutOptimizer:
         self._step_count += 1
         if self._step_count % self.k == 0:
             flat, treedef = jax.tree_util.tree_flatten(adapted)
-            merged = []
-            for i, leaf in enumerate(flat):
-                name = f"{self.prefix}.{i}"
-                windows.win_put(leaf, name)  # also refreshes the exposure
-                merged.append(windows.win_update(name))
-            adapted = jax.tree_util.tree_unflatten(treedef, merged)
+            if self.fuse:
+                for g, (idxs, shapes) in enumerate(self._groups):
+                    name = f"{self.prefix}.fused{g}"
+                    pack = ctx.jit_cache(
+                        ("winput_pack", shapes),
+                        lambda: jax.jit(_pack_leaves),
+                    )
+                    unpack = ctx.jit_cache(
+                        ("winput_unpack", shapes),
+                        lambda shapes=shapes: jax.jit(
+                            functools.partial(_unpack_leaves, shapes=shapes)
+                        ),
+                    )
+                    windows.win_put(pack([flat[i] for i in idxs]), name)
+                    parts = unpack(windows.win_update(name))
+                    for i, part in zip(idxs, parts):
+                        flat[i] = part
+            else:
+                for i, leaf in enumerate(flat):
+                    name = f"{self.prefix}.{i}"
+                    windows.win_put(leaf, name)  # also refreshes the exposure
+                    flat[i] = windows.win_update(name)
+            adapted = jax.tree_util.tree_unflatten(treedef, flat)
         return adapted, state
 
     def free(self):

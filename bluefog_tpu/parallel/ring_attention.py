@@ -98,8 +98,8 @@ def ring_flash_attention(
     axis_size: int,
     *,
     causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 256,
+    block_k: int = 256,
     interpret: bool = None,
 ) -> jnp.ndarray:
     """Ring attention with the Pallas flash kernel as the per-hop compute.

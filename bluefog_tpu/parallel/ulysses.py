@@ -51,8 +51,8 @@ def ulysses_attention(
     *,
     causal: bool = True,
     flash: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 256,
+    block_k: int = 256,
     interpret: bool = None,
 ) -> jnp.ndarray:
     """Exact attention across sequence shards via head re-sharding.

@@ -65,6 +65,7 @@ __all__ = [
     "win_mutex",
     "get_win_version",
     "win_associated_p",
+    "win_set_exposed",
     "turn_on_win_ops_with_associated_p",
     "turn_off_win_ops_with_associated_p",
 ]
@@ -451,6 +452,23 @@ def win_associated_p(name: str) -> jnp.ndarray:
     """The push-sum associated scalar p per rank (reference
     ``bf.win_associated_p`` [U])."""
     return _win(name).p_self
+
+
+def win_set_exposed(name: str, tensor, associated_p=None) -> None:
+    """Overwrite the window's exposed tensor (and optionally its associated
+    p) without a put — the debias-and-restart idiom of push-sum loops, where
+    the caller stores x/p back as the new x and resets p to 1.  The reference
+    gets this for free because its windows alias the torch tensor [U]; the
+    mailbox emulation needs an explicit setter."""
+    win = _win(name)
+    t = jnp.asarray(tensor, dtype=win.dtype)
+    if t.shape != win.shape:
+        raise ValueError(f"shape {t.shape} != window shape {win.shape}")
+    win.self_tensor = t
+    if associated_p is not None:
+        win.p_self = jnp.broadcast_to(
+            jnp.asarray(associated_p, jnp.float32), win.p_self.shape
+        )
 
 
 def turn_on_win_ops_with_associated_p() -> None:

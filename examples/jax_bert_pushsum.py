@@ -100,11 +100,8 @@ def main():
             merged.append(
                 m / p_assoc.reshape((n,) + (1,) * (m.ndim - 1)).astype(m.dtype)
             )
-            # reset p for the next round's debiasing
-            from bluefog_tpu import windows as W
-
-            W._win(name).p_self = jnp.ones_like(W._win(name).p_self)
-            W._win(name).self_tensor = merged[-1]
+            # store the debiased value back and reset p for the next round
+            bf.win_set_exposed(name, merged[-1], associated_p=1.0)
         params = jax.tree_util.tree_unflatten(treedef, merged)
         if (step + 1) % 10 == 0:
             print(f"step {step + 1:3d}: mean loss {float(np.asarray(loss).mean()):.4f}")

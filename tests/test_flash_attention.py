@@ -41,6 +41,28 @@ def test_flash_uneven_q_k_blocks():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+def test_flash_gradients_indivisible_length():
+    """T=40 with requested block 16: _fit_block shrinks both forward AND
+    backward blocking; the backward must cover the tail keys (regression:
+    an unfitted backward block silently zeroed tail dK/dV)."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(5), 1, 40, 2, 8)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(
+            q, k, v, causal=True, block_q=16, block_k=16, interpret=True
+        )
+        return jnp.sum(jnp.sin(o))
+
+    def loss_dense(q, k, v):
+        return jnp.sum(jnp.sin(dense_attention(q, k, v, causal=True)))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd in zip(g_flash, g_dense):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd), atol=3e-5)
+        assert float(jnp.abs(gf[:, -8:]).max()) > 0  # tail keys got gradient
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_flash_gradients_match_dense(causal):
     q, k, v = _rand_qkv(jax.random.PRNGKey(2), 1, 32, 2, 8)

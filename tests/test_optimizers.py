@@ -134,6 +134,33 @@ def test_winput_optimizer_consensus():
     opt.free()
 
 
+def test_winput_fused_matches_per_leaf():
+    """Leaf fusion (one packed window per dtype) is exactly the per-leaf
+    schedule: same topology weights apply to every leaf."""
+    bf.set_topology(tu.ExponentialTwoGraph(SIZE))
+    params = {
+        "a": rank_params((3,))["w"],
+        "b": rank_params((2, 2))["w"] * 2.0,
+        "c": jnp.ones((SIZE, 5), jnp.float32) * jnp.arange(SIZE)[:, None],
+    }
+    grads = {k: jnp.ones_like(v) * 0.1 for k, v in params.items()}
+    results = {}
+    for fuse in (False, True):
+        opt = bf.DistributedWinPutOptimizer(
+            optax.sgd(0.05), window_prefix=f"fuse_eq_{fuse}", fuse=fuse
+        )
+        state = opt.init(params)
+        cur = params
+        for _ in range(4):
+            cur, state = opt.step(cur, grads, state)
+        results[fuse] = cur
+        opt.free()
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(results[True][k]), np.asarray(results[False][k]), rtol=1e-6
+        )
+
+
 def _quadratic_loss_grads(params, targets):
     # per-rank quadratic: L_r = 0.5 || w_r - t_r ||^2, grad = w_r - t_r
     return {"w": params["w"] - targets}

@@ -161,6 +161,23 @@ def test_push_sum_with_associated_p():
     np.testing.assert_allclose(debiased, np.tile(mean0, (SIZE, 1)), atol=1e-2)
 
 
+def test_win_set_exposed_debias_restart():
+    """win_set_exposed stores a new exposed tensor + resets p — the push-sum
+    debias-and-restart idiom without touching window internals."""
+    bf.turn_on_win_ops_with_associated_p()
+    bf.set_topology(tu.RingGraph(SIZE))
+    x = rank_tensor()
+    bf.win_create(x, "w")
+    new_val = jnp.ones_like(x) * 7.0
+    bf.win_set_exposed("w", new_val, associated_p=1.0)
+    np.testing.assert_allclose(np.asarray(bf.win_update("w", self_weight=1.0,
+                                                        neighbor_weights=[{} for _ in range(SIZE)])),
+                               np.asarray(new_val))
+    np.testing.assert_allclose(np.asarray(bf.win_associated_p("w")), 1.0)
+    with pytest.raises(ValueError):
+        bf.win_set_exposed("w", jnp.ones((SIZE, 99)))
+
+
 def test_selective_win_put_touches_only_listed_ranks():
     """A put with dst_weights listing one neighbor must leave every other
     mailbox slot (and version counter) untouched."""
