@@ -60,10 +60,18 @@ def _block_spec(shape, index_map):
 def _fit_block(t, b):
     """Largest power-of-two shrink of ``b`` that divides sequence length
     ``t`` (capped at ``t`` itself), so default block sizes adapt to short or
-    odd shards instead of raising."""
+    odd shards instead of raising.  Lengths whose largest fitting block is
+    degenerate (< 8 sublanes, e.g. odd primes) still raise loudly — a
+    near-1-row Pallas grid would be pathologically slow or fail Mosaic
+    layout opaquely."""
     b = min(b, t)
     while t % b and b > 1:
         b = max(b // 2, 1)
+    if b < 8 and b < t:
+        raise ValueError(
+            f"no block size >= 8 divides sequence length {t} (best fit {b}); "
+            f"pad the sequence/shard to a multiple of 8"
+        )
     return b
 
 
