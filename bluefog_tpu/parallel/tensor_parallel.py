@@ -23,6 +23,7 @@ P("tp")``.
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Any, Callable, Dict, Optional
 
@@ -31,6 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = [
+    "reduce_from_tp_region",
     "column_parallel_dense",
     "row_parallel_dense",
     "tp_mlp",
@@ -39,10 +41,50 @@ __all__ = [
     "init_tp_block_params",
     "TP_BLOCK_SHARD_AXES",
     "shard_tp_params",
+    "split_tp_params",
+    "merge_tp_params",
     "unshard_tp_params",
 ]
 
 TP_AXIS = "tp"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tp_region(x, axis_name: str = TP_AXIS):
+    """Megatron's **g** operator: ``psum`` forward, *identity* backward.
+
+    A raw ``lax.psum`` transposes to another ``psum`` — correct for
+    device-varying losses, but here the downstream loss is replicated over
+    tp, so the raw transpose would multiply every cotangent by the axis
+    size.  The identity backward hands each shard the (already replicated)
+    cotangent once, making sharded-weight gradients the exact shard of the
+    full gradient.
+
+    Megatron's conjugate **f** operator (identity forward, psum backward,
+    restoring replicated activation cotangents at region entry) needs no
+    code here: JAX's varying-manual-axes typing auto-inserts ``pvary``
+    where the replicated stream meets a tp-varying operand, and ``pvary``'s
+    transpose is exactly that psum.
+    """
+    return lax.psum(x, axis_name)
+
+
+def _reduce_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _reduce_bwd(axis_name, _, g):
+    # the primal input is tp-varying; re-type the (replicated) cotangent to
+    # match under shard_map's varying-manual-axes checking
+    if hasattr(lax, "pcast"):  # current vma-typing API
+        g = lax.pcast(g, axis_name, to="varying")
+    elif hasattr(lax, "pvary"):  # its deprecated predecessor
+        g = lax.pvary(g, axis_name)
+    # (pre-vma jax: no re-typing needed)
+    return (g,)
+
+
+reduce_from_tp_region.defvjp(_reduce_fwd, _reduce_bwd)
 
 
 def column_parallel_dense(x, kernel, bias=None):
@@ -60,7 +102,7 @@ def row_parallel_dense(x, kernel, bias=None, axis_name: str = TP_AXIS):
     features sharded, one ``psum`` to assemble the output (Megatron's g)."""
     y = jnp.einsum("...i,io->...o", x, kernel,
                    preferred_element_type=jnp.float32).astype(x.dtype)
-    y = lax.psum(y, axis_name)
+    y = reduce_from_tp_region(y, axis_name)
     if bias is not None:
         y = y + bias  # bias replicated: add once, after the reduction
     return y
@@ -101,7 +143,7 @@ def tp_self_attention(
         att = attention_fn(q, k, v)
     out = jnp.einsum("bthd,hdm->btm", att, params["wo"],
                      preferred_element_type=jnp.float32).astype(dtype)
-    return lax.psum(out, axis_name)
+    return reduce_from_tp_region(out, axis_name)
 
 
 def _rms_norm(x, scale, eps: float = 1e-6):
@@ -169,22 +211,45 @@ def init_tp_block_params(key, d_model: int, num_heads: int, dff: int,
 
 def _tree_map_with_axes(fn, params, axes):
     """Map ``fn(leaf, shard_axis_or_None)`` over params following the
-    ``axes`` spec tree (dict mirroring params; None subtree = replicated)."""
+    ``axes`` spec tree (dict/list mirroring params; a None or int spec at a
+    subtree applies to every leaf under it)."""
     if isinstance(params, dict):
-        return {
-            k: _tree_map_with_axes(
-                fn, v, axes.get(k) if isinstance(axes, dict) else axes
+        if isinstance(axes, dict):
+            missing = set(params) - set(axes)
+            if missing:
+                raise ValueError(
+                    f"axes spec is missing keys {sorted(missing)}; list every "
+                    f"key explicitly (use None for replicated leaves)"
+                )
+            return {
+                k: _tree_map_with_axes(fn, v, axes[k]) for k, v in params.items()
+            }
+        return {k: _tree_map_with_axes(fn, v, axes) for k, v in params.items()}
+    if isinstance(params, (list, tuple)):
+        sub = axes if isinstance(axes, (list, tuple)) else [axes] * len(params)
+        if len(sub) != len(params):
+            raise ValueError(
+                f"axes list length {len(sub)} != params list length {len(params)}"
             )
-            for k, v in params.items()
-        }
+        out = [_tree_map_with_axes(fn, p, a) for p, a in zip(params, sub)]
+        if isinstance(params, tuple):
+            # namedtuples take fields positionally
+            return type(params)(*out) if hasattr(params, "_fields") else tuple(out)
+        return out
     return fn(params, axes)
 
 
 def shard_tp_params(params, axes, tp: int):
     """Full params -> stacked ``[tp, ...]`` leaves (replicated leaves tiled),
-    ready for ``shard_map`` ``in_specs P("tp")`` (use ``leaf[0]`` inside)."""
+    ready for ``shard_map`` ``in_specs P("tp")`` (use ``leaf[0]`` inside).
+
+    Tiling replicated leaves is fine for *inference/forward* use; for
+    training, route them around the tp axis instead via
+    :func:`split_tp_params` (see its docstring for why)."""
 
     def shard(leaf, ax):
+        if leaf is None:  # placeholder from split_tp_params
+            return None
         leaf = jnp.asarray(leaf)
         if ax is None:
             return jnp.broadcast_to(leaf[None], (tp,) + leaf.shape)
@@ -202,10 +267,44 @@ def shard_tp_params(params, axes, tp: int):
     return _tree_map_with_axes(shard, params, axes)
 
 
+def split_tp_params(params, axes):
+    """Split a full parameter tree into ``(replicated, sharded)`` subtrees
+    by the axes spec (``None`` = replicated), with ``None`` placeholders at
+    the other tree's positions.
+
+    **This split is the correct-training layout rule.**  Sharded leaves go
+    through :func:`shard_tp_params` and enter ``shard_map`` tp-varying
+    (``P(..., "tp")``); replicated leaves must enter tp-*invariant*
+    (``P()``, or ``P("bf_nodes")`` when stacked over a gossip axis) — then
+    JAX's varying-manual-axes machinery transposes the replicated→varying
+    boundary into exactly Megatron's f-operator psum, and every gradient
+    (including norms/embeddings) comes out correct with no manual sync.
+    Feeding replicated leaves through the stacked tp layout instead types
+    them varying: their backward then mixes full (replicated-path) and
+    partial (sharded-path) contributions per shard, which no uniform
+    psum/identity rule can repair.
+    """
+    repl = _tree_map_with_axes(lambda l, ax: l if ax is None else None, params, axes)
+    shard = _tree_map_with_axes(lambda l, ax: None if ax is None else l, params, axes)
+    return repl, shard
+
+
+def merge_tp_params(replicated, sharded):
+    """Inverse of :func:`split_tp_params`: fill each ``None`` placeholder
+    from the other tree."""
+    return jax.tree_util.tree_map(
+        lambda a, b: b if a is None else a,
+        replicated, sharded,
+        is_leaf=lambda x: x is None,
+    )
+
+
 def unshard_tp_params(params, axes):
     """Inverse of :func:`shard_tp_params` (stacked ``[tp, ...]`` -> full)."""
 
     def unshard(leaf, ax):
+        if leaf is None:  # placeholder from split_tp_params
+            return None
         leaf = jnp.asarray(leaf)
         if ax is None:
             return leaf[0]

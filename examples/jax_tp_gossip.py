@@ -1,0 +1,173 @@
+"""Tensor-parallel x decentralized-gossip training — the composition the
+reference cannot express (its models are always fully replicated per rank;
+SURVEY.md §2.3).
+
+A 2-layer transformer LM is sharded Megatron-style over a ``tp`` mesh axis
+(``bluefog_tpu.parallel.tensor_parallel``) while independent model replicas
+gossip their TP-sharded parameters over the ``bf_nodes`` axis with
+neighbor averaging — every collective on one mesh, scheduled by XLA: the
+block's two psums ride the minor (tp) axis, the gossip ppermutes ride the
+major (dp) axis.
+
+Run (CPU mesh): JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/jax_tp_gossip.py --steps 30
+"""
+
+import argparse
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bluefog_tpu import ops_spmd
+from bluefog_tpu import topology_util as tu
+from bluefog_tpu.core.plan import compile_plan
+from bluefog_tpu.parallel import tensor_parallel as tpp
+
+VOCAB = 128
+
+
+def init_params(key, d_model, heads, dff, layers, dtype=jnp.float32):
+    ks = jax.random.split(key, layers + 2)
+    return {
+        "embed": jax.random.normal(ks[0], (VOCAB, d_model), dtype) * 0.02,
+        "blocks": [
+            tpp.init_tp_block_params(ks[1 + i], d_model, heads, dff, dtype=dtype)
+            for i in range(layers)
+        ],
+        "unembed": jax.random.normal(ks[-1], (d_model, VOCAB), dtype) * 0.02,
+    }
+
+
+def param_axes(layers):
+    return {
+        "embed": None,
+        "blocks": [tpp.TP_BLOCK_SHARD_AXES for _ in range(layers)],
+        "unembed": None,
+    }
+
+
+def forward(params, ids):
+    """ids [B, T] -> logits [B, T, V]; runs inside shard_map (tp axis)."""
+    x = params["embed"][ids]
+    for blk in params["blocks"]:
+        x = tpp.tp_transformer_block(x, blk, causal=True)
+    return jnp.einsum("btm,mv->btv", x, params["unembed"])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--dp", type=int, default=4)
+    ap.add_argument("--tp", type=int, default=2)
+    ap.add_argument("--d-model", type=int, default=32)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--dff", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--batch", type=int, default=8, help="per dp rank")
+    ap.add_argument("--seq", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+
+    devices = jax.devices()
+    need = args.dp * args.tp
+    if len(devices) < need:
+        raise SystemExit(
+            f"need {need} devices (dp={args.dp} x tp={args.tp}), "
+            f"have {len(devices)} — set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={need}"
+        )
+    mesh = Mesh(np.array(devices[:need]).reshape(args.dp, args.tp),
+                ("bf_nodes", "tp"))
+    plan = compile_plan(tu.ExponentialTwoGraph(args.dp))
+    axes = param_axes(args.layers)
+
+    # each dp rank starts from its own init — gossip pulls them together.
+    # Layout rule (split_tp_params docstring): sharded leaves enter stacked
+    # [dp, tp, ...] / P("bf_nodes", "tp"); replicated leaves (embed, norms,
+    # unembed) enter [dp, ...] / P("bf_nodes") — tp-INVARIANT, so their
+    # gradients assemble correctly with no manual sync.
+    per_repl, per_shard = [], []
+    for r in range(args.dp):
+        repl_r, shard_r = tpp.split_tp_params(
+            init_params(jax.random.PRNGKey(r), args.d_model, args.heads,
+                        args.dff, args.layers),
+            axes,
+        )
+        per_repl.append(repl_r)
+        per_shard.append(tpp.shard_tp_params(shard_r, axes, args.tp))
+    stack = lambda *ls: jnp.stack(ls)
+    repl = jax.tree_util.tree_map(stack, *per_repl)
+    shard = jax.tree_util.tree_map(stack, *per_shard)
+    opt = optax.sgd(args.lr, momentum=0.9)
+    opt_repl = jax.tree_util.tree_map(stack, *[opt.init(p) for p in per_repl])
+    opt_shard = jax.tree_util.tree_map(stack, *[opt.init(p) for p in per_shard])
+
+    def loss_fn(p_repl, p_shard, ids):
+        p = tpp.merge_tp_params(p_repl, p_shard)
+        logits = forward(p, ids[:, :-1])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, ids[:, 1:]
+        ).mean()
+
+    def spmd_step(repl, shard, opt_r, opt_s, ids):
+        take1 = functools.partial(jax.tree_util.tree_map, lambda a: a[0])
+        take2 = functools.partial(jax.tree_util.tree_map, lambda a: a[0, 0])
+        pr, ps, sr, ss = take1(repl), take2(shard), take1(opt_r), take2(opt_s)
+        loss, (gr, gs) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+            pr, ps, ids[0]
+        )
+        ur, sr = opt.update(gr, sr, pr)
+        pr = optax.apply_updates(pr, ur)
+        us, ss = opt.update(gs, ss, ps)
+        ps = optax.apply_updates(ps, us)
+        # gossip mixes *parameters* across dp replicas (ATC)
+        pr = ops_spmd.neighbor_allreduce(pr, plan, "bf_nodes")
+        ps = ops_spmd.neighbor_allreduce(ps, plan, "bf_nodes")
+        e1 = functools.partial(jax.tree_util.tree_map, lambda a: a[None])
+        e2 = functools.partial(jax.tree_util.tree_map, lambda a: a[None, None])
+        loss = jax.lax.pmean(loss, "bf_nodes")[None]
+        return e1(pr), e2(ps), e1(sr), e2(ss), loss
+
+    step = jax.jit(
+        jax.shard_map(
+            spmd_step, mesh=mesh,
+            in_specs=(P("bf_nodes"), P("bf_nodes", "tp"), P("bf_nodes"),
+                      P("bf_nodes", "tp"), P("bf_nodes")),
+            out_specs=(P("bf_nodes"), P("bf_nodes", "tp"), P("bf_nodes"),
+                       P("bf_nodes", "tp"), P("bf_nodes")),
+        )
+    )
+
+    rng = np.random.default_rng(0)
+
+    def batch():
+        # learnable synthetic language: next token = (token + 1) mod VOCAB
+        start = rng.integers(0, VOCAB, size=(args.dp, args.batch, 1))
+        ids = (start + np.arange(args.seq + 1)) % VOCAB
+        return jnp.asarray(ids, jnp.int32)
+
+    for i in range(args.steps):
+        repl, shard, opt_repl, opt_shard, loss = step(
+            repl, shard, opt_repl, opt_shard, batch()
+        )
+        if (i + 1) % 10 == 0 or i == 0:
+            # consensus spread across dp replicas (one sharded, one
+            # replicated leaf)
+            w = np.asarray(shard["blocks"][0]["mlp"]["wi"])
+            spread = float(np.abs(w - w.mean(axis=0, keepdims=True)).max())
+            e = np.asarray(repl["embed"])
+            espread = float(np.abs(e - e.mean(axis=0, keepdims=True)).max())
+            print(
+                f"step {i + 1:3d}: loss {float(np.asarray(loss).mean()):.4f} "
+                f"consensus-spread {spread:.2e} (embed {espread:.2e})"
+            )
+
+    print(f"done: dp={args.dp} tp={args.tp} on {need} devices")
+
+
+if __name__ == "__main__":
+    main()

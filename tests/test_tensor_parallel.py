@@ -51,6 +51,33 @@ def test_shard_unshard_roundtrip():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_shard_list_subtrees():
+    """Axes specs follow list subtrees (e.g. a stack of blocks) and a
+    single spec broadcasts over list elements."""
+    p = {"blocks": [full_params(), full_params(2.0)], "embed": jnp.ones((6, 4))}
+    axes = {"blocks": [tpp.TP_BLOCK_SHARD_AXES, tpp.TP_BLOCK_SHARD_AXES],
+            "embed": None}
+    stacked = tpp.shard_tp_params(p, axes, 2)
+    assert stacked["blocks"][1]["mlp"]["wi"].shape == (2, D_MODEL, DFF // 2)
+    assert stacked["embed"].shape == (2, 6, 4)
+    back = tpp.unshard_tp_params(stacked, axes)
+    np.testing.assert_array_equal(
+        np.asarray(back["blocks"][1]["mlp"]["wi"]),
+        np.asarray(p["blocks"][1]["mlp"]["wi"]),
+    )
+    with pytest.raises(ValueError):
+        tpp.shard_tp_params(p, {"blocks": [None], "embed": None}, 2)
+    # a single (non-list) spec broadcasts over every list element
+    bcast = tpp.shard_tp_params(
+        p, {"blocks": tpp.TP_BLOCK_SHARD_AXES, "embed": None}, 2
+    )
+    for b in range(2):
+        np.testing.assert_array_equal(
+            np.asarray(bcast["blocks"][b]["mlp"]["wi"]),
+            np.asarray(stacked["blocks"][b]["mlp"]["wi"]),
+        )
+
+
 def test_indivisible_tp_raises():
     with pytest.raises(ValueError):
         tpp.shard_tp_params(full_params(), tpp.TP_BLOCK_SHARD_AXES, 3)
@@ -74,6 +101,57 @@ def test_tp_block_matches_full(devices):
     )(x, stacked)
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(reference_block(x, p)), atol=2e-4
+    )
+
+
+def test_tp_block_gradients_replicated_and_match(devices):
+    """Backward correctness under the split layout (the training layout
+    rule): grads of the input and of replicated leaves come out tp-INVARIANT
+    (enforced by the out_specs) and equal the full model's gradients;
+    sharded-leaf grads equal the matching shard of the full gradient."""
+    mesh = Mesh(np.array(devices).reshape(8), ("tp",))
+    p = full_params()
+    repl, shard = tpp.split_tp_params(p, tpp.TP_BLOCK_SHARD_AXES)
+    shard = tpp.shard_tp_params(shard, tpp.TP_BLOCK_SHARD_AXES, 8)
+    x = jax.random.normal(jax.random.PRNGKey(7), (2, 8, D_MODEL), jnp.float32)
+
+    def spmd(x, repl, shard):
+        local = jax.tree_util.tree_map(lambda a: a[0], shard)
+
+        def loss(x, repl, local):
+            lp = tpp.merge_tp_params(repl, local)
+            return jnp.sum(jnp.sin(tpp.tp_transformer_block(x, lp, causal=True)))
+
+        dx, drepl, dshard = jax.grad(loss, argnums=(0, 1, 2))(x, repl, local)
+        return dx, drepl, jax.tree_util.tree_map(lambda a: a[None], dshard)
+
+    # out_specs P() for dx/drepl: shard_map itself verifies tp-invariance
+    dx, drepl, dshard = jax.jit(
+        jax.shard_map(
+            spmd, mesh=mesh,
+            in_specs=(P(), P(), P("tp")),
+            out_specs=(P(), P(), P("tp")),
+        )
+    )(x, repl, shard)
+
+    def ref_loss(x, p):
+        return jnp.sum(jnp.sin(reference_block(x, p)))
+
+    rdx, rdp = jax.grad(ref_loss, argnums=(0, 1))(x, p)
+
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(rdx), atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(drepl["norm1"]), np.asarray(rdp["norm1"]), atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(drepl["norm2"]), np.asarray(rdp["norm2"]), atol=2e-4
+    )
+    # sharded leaf (mlp wi): shard t of the full gradient
+    rwi = np.asarray(rdp["mlp"]["wi"]).reshape(D_MODEL, 8, DFF // 8)
+    np.testing.assert_allclose(
+        np.asarray(dshard["mlp"]["wi"]),
+        np.moveaxis(rwi, 1, 0),
+        atol=2e-4,
     )
 
 
