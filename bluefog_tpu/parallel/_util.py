@@ -5,20 +5,23 @@ from __future__ import annotations
 from jax import lax
 
 
-def resolve_axis_size(axis_name: str, axis_size: int) -> int:
+def resolve_axis_size(axis_name: str, axis_size) -> int:
     """Validate ``axis_size`` against the mesh axis it names.
 
     Inside a shard_map/pmap trace the bound axis size is authoritative: a
     stale ``axis_size`` argument would otherwise produce silently wrong
     causal masks (ring) or an opaque XLA dimension error (ulysses
     all_to_all).  Outside a trace the axis is unbound and the passed value
-    is all we have.
+    is all we have.  ``axis_size=None`` means "no caller claim": allowed
+    inside a trace, an error outside one.
     """
     try:
         n = lax.axis_size(axis_name)
     except NameError:
+        if axis_size is None:
+            raise
         return axis_size
-    if axis_size != n:
+    if axis_size is not None and axis_size != n:
         raise ValueError(
             f"axis_size={axis_size} does not match the actual size of mesh "
             f"axis {axis_name!r} ({n})"

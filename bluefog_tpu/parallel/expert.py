@@ -1,0 +1,110 @@
+"""Expert parallelism: Switch-style mixture-of-experts over an ``ep`` axis.
+
+No sibling in the reference (SURVEY.md §2.3: EP honestly absent upstream) —
+the last of the composition bonuses (see :mod:`.tensor_parallel`,
+:mod:`.pipeline`).  Experts shard over the ``ep`` mesh axis; tokens live
+sharded over the same axis (each device routes its own token shard), and
+dispatch/return ride a single ``lax.all_to_all`` pair — the canonical
+TPU MoE wire pattern (Fedus et al., arXiv:2101.03961; Lepikhin et al.,
+arXiv:2006.16668).
+
+TPU-first choices: routing is the dense one-hot dispatch/combine einsum
+formulation (everything stays MXU-shaped — no gather/scatter, no dynamic
+shapes), capacity is static (``capacity_factor``), overflow tokens pass
+through the residual untouched (standard Switch behavior).  Everything is
+differentiable, including the router (gate probability scales the expert
+output, the straight-through-free Switch estimator).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bluefog_tpu.parallel._util import resolve_axis_size
+
+__all__ = ["switch_moe", "init_moe_params", "EP_AXIS"]
+
+EP_AXIS = "ep"
+
+
+def init_moe_params(key, d_model: int, d_ff: int, num_experts: int,
+                    dtype=jnp.float32):
+    """Full (unsharded) MoE params: router [d, E] (replicated), expert
+    stacks wi [E, d, f] / wo [E, f, d] (shard axis 0 over ep: pass
+    ``leaf.reshape(ep, E//ep, ...)`` stacked, or use ``in_specs
+    P("ep")`` directly on the expert axis)."""
+    kr, ki, ko = jax.random.split(key, 3)
+    scale_in = 1.0 / jnp.sqrt(d_model)
+    scale_out = 1.0 / jnp.sqrt(d_ff)
+    return {
+        "router": (jax.random.normal(kr, (d_model, num_experts), jnp.float32)
+                   * 0.02).astype(dtype),
+        "wi": (jax.random.normal(ki, (num_experts, d_model, d_ff), jnp.float32)
+               * scale_in).astype(dtype),
+        "wo": (jax.random.normal(ko, (num_experts, d_ff, d_model), jnp.float32)
+               * scale_out).astype(dtype),
+    }
+
+
+def switch_moe(
+    x,
+    params,
+    axis_name: str = EP_AXIS,
+    *,
+    capacity_factor: float = 1.25,
+    axis_size: Optional[int] = None,
+    activation=jax.nn.gelu,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-1 (Switch) MoE layer; call inside ``shard_map``.
+
+    ``x [T_local, d]`` — this device's token shard.  ``params``: ``router
+    [d, E]`` replicated; ``wi [E_local, d, f]`` / ``wo [E_local, f, d]`` —
+    this device's expert shard (``E = ep * E_local``).
+
+    Returns ``(out [T_local, d], aux_loss)`` where ``aux_loss`` is the
+    Switch load-balancing term (mean over devices), already ``pmean``-ed.
+    """
+    n = int(resolve_axis_size(axis_name, axis_size))
+    e_local = params["wi"].shape[0]
+    E = n * e_local
+    T = x.shape[0]
+    # per-device, per-expert slot budget
+    cap = max(1, int(capacity_factor * T / E))
+
+    logits = jnp.einsum("td,de->te", x, params["router"],
+                        preferred_element_type=jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)  # [T, E] fp32
+    expert = jnp.argmax(probs, axis=-1)  # [T]
+    gate = jnp.max(probs, axis=-1)  # [T]
+
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # [T, E]
+    # position of each token within its expert's slots (this device's view)
+    pos = (jnp.cumsum(onehot, axis=0) * onehot - 1.0).astype(jnp.int32)
+    keep = (pos >= 0) & (pos < cap)  # [T, E]; -1 marks inactive pairs
+    slot = jax.nn.one_hot(pos, cap, dtype=jnp.float32)  # [T, E, cap]
+    dispatch = slot * keep[..., None]  # [T, E, cap] 0/1
+    combine = dispatch * gate[:, None, None]  # gradient flows to the router
+
+    wdt = x.dtype
+    # gather tokens into expert slots: [E, cap, d]
+    xin = jnp.einsum("td,tec->ecd", x, dispatch.astype(wdt))
+    # ship slots to their expert's device: [E_local, n * cap, d]
+    xin = lax.all_to_all(xin, axis_name, split_axis=0, concat_axis=1, tiled=True)
+    h = activation(jnp.einsum("ecd,edf->ecf", xin, params["wi"],
+                              preferred_element_type=jnp.float32).astype(wdt))
+    y = jnp.einsum("ecf,efd->ecd", h, params["wo"],
+                   preferred_element_type=jnp.float32).astype(wdt)
+    # return slots to their source device: [E, cap, d]
+    y = lax.all_to_all(y, axis_name, split_axis=1, concat_axis=0, tiled=True)
+    out = jnp.einsum("ecd,tec->td", y, combine.astype(wdt))
+
+    # Switch aux loss: E * <fraction routed to e> . <mean router prob e>,
+    # averaged over devices
+    frac = onehot.mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    aux = lax.pmean(E * jnp.sum(frac * mean_prob), axis_name)
+    return out, aux

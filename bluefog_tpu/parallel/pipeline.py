@@ -1,0 +1,123 @@
+"""Pipeline parallelism over a dedicated mesh axis (GPipe-style).
+
+No sibling in the reference — it is a decentralized data-parallel framework
+with replicated models (SURVEY.md §2.3: PP honestly absent upstream).  Like
+:mod:`.tensor_parallel`, this is a composition bonus: a ``pp`` mesh axis
+holding one *stage* (a contiguous slice of layers) per device, designed to
+compose with the gossip axis on a ``("bf_nodes", "pp")`` mesh.
+
+TPU-first design: the whole schedule is one ``lax.scan`` inside
+``shard_map`` — no host round-trips, no per-tick dispatch.  Microbatches
+stream stage-to-stage via single-hop ``lax.ppermute`` (nearest-neighbor on
+the ICI torus), the classic GPipe fill/drain bubble of ``pp - 1`` ticks at
+each end.  The scan is differentiable end-to-end (``ppermute`` transposes
+to the reverse permutation), so backward is the mirrored pipeline for free
+— XLA handles activation storage; wrap ``stage_fn`` in ``jax.checkpoint``
+for rematerialized long pipelines.
+
+Layout: every device holds ITS stage's parameters (stacked ``[pp, ...]``
+outside, ``in_specs P("pp")``).  The per-stage function must map
+``(stage_params, activation) -> activation`` with one signature for every
+stage (the usual homogeneous-transformer assumption).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bluefog_tpu.parallel._util import resolve_axis_size
+from bluefog_tpu.parallel.tensor_parallel import reduce_from_tp_region
+
+__all__ = ["pipeline_apply", "stack_stage_params", "PP_AXIS"]
+
+PP_AXIS = "pp"
+
+
+def stack_stage_params(per_stage_params):
+    """List of per-stage parameter pytrees -> stacked ``[pp, ...]`` leaves
+    for ``shard_map`` ``in_specs P("pp")`` (use ``leaf[0]`` inside)."""
+    return jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *per_stage_params)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stage_params,
+    x,
+    axis_name: str = PP_AXIS,
+    *,
+    num_microbatches: int,
+    axis_size: Optional[int] = None,
+):
+    """Run the pipeline: ``x [num_micro * mb, ...]`` -> same shape.
+
+    Called inside ``shard_map``; ``stage_params`` is this device's stage's
+    parameter pytree.  Every device passes the same (replicated) ``x`` and
+    receives the same (replicated) output — the input is logically consumed
+    by stage 0 and the output produced by the last stage, with a masked
+    ``psum`` replicating it back (so the result composes with downstream
+    replicated compute, e.g. a loss).
+
+    The schedule runs ``num_micro + pp - 1`` ticks; microbatch ``m`` is
+    injected at tick ``m``, transformed by stage ``s`` at tick ``m + s``,
+    and collected after its last-stage tick.
+    """
+    n = int(resolve_axis_size(axis_name, axis_size))
+    idx = lax.axis_index(axis_name)
+    total = x.shape[0]
+    if total % num_microbatches:
+        raise ValueError(
+            f"batch {total} not divisible by num_microbatches={num_microbatches}"
+        )
+    mb = total // num_microbatches
+    micro = x.reshape((num_microbatches, mb) + x.shape[1:])
+    ticks = num_microbatches + n - 1
+    fwd_perm = [(i, i + 1) for i in range(n - 1)]
+
+    def tick(carry, t):
+        state, outs = carry
+        # stage 0 swallows the next microbatch (zeros once drained)
+        inject = jnp.where(
+            t < num_microbatches,
+            lax.dynamic_index_in_dim(
+                micro, jnp.minimum(t, num_microbatches - 1), keepdims=False
+            ),
+            jnp.zeros_like(state),
+        )
+        state = jnp.where(idx == 0, inject, state)
+        state = stage_fn(stage_params, state)
+        # the last stage banks microbatch m at tick m + n - 1
+        m = t - (n - 1)
+        valid = (idx == n - 1) & (m >= 0)
+        outs = jnp.where(
+            valid,
+            lax.dynamic_update_index_in_dim(
+                outs, state.astype(outs.dtype), jnp.maximum(m, 0), axis=0
+            ),
+            outs,
+        )
+        # stream every in-flight activation one stage forward
+        state = lax.ppermute(state, axis_name, fwd_perm)
+        return (state, outs), None
+
+    def pvary(a):  # scan carries become pp-varying; type the inits to match
+        if hasattr(lax, "pcast"):
+            return lax.pcast(a, axis_name, to="varying")
+        if hasattr(lax, "pvary"):
+            return lax.pvary(a, axis_name)
+        return a
+
+    state0 = pvary(jnp.zeros_like(micro[0]))
+    outs0 = pvary(jnp.zeros_like(micro))
+    (_, outs), _ = lax.scan(tick, (state0, outs0), jnp.arange(ticks))
+    # replicate the last stage's collected outputs to every stage.  The
+    # masked psum must be the g operator (identity backward): a raw psum
+    # would transpose to another psum and scale every stage's gradients by
+    # pp under a replicated downstream loss (see tensor_parallel).
+    outs = reduce_from_tp_region(
+        jnp.where(idx == n - 1, outs, jnp.zeros_like(outs)), axis_name
+    )
+    return outs.reshape((total,) + x.shape[1:])

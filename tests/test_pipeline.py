@@ -1,0 +1,161 @@
+"""GPipe-style pipeline parallelism: the streamed schedule matches running
+the stages sequentially, forward and backward, and composes with the gossip
+axis (PP absent upstream — SURVEY.md §2.3; bonus like tensor_parallel)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bluefog_tpu import ops_spmd
+from bluefog_tpu import topology_util as tu
+from bluefog_tpu.core.plan import compile_plan
+from bluefog_tpu.parallel import pipeline as pp
+
+DIM = 8
+
+
+def stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def make_stage(key):
+    kw, kb = jax.random.split(key)
+    return {
+        "w": jax.random.normal(kw, (DIM, DIM), jnp.float32) / np.sqrt(DIM),
+        "b": jax.random.normal(kb, (DIM,), jnp.float32) * 0.1,
+    }
+
+
+def sequential(per_stage, x):
+    for p in per_stage:
+        x = stage_fn(p, x)
+    return x
+
+
+@pytest.mark.parametrize("n_stages,num_micro", [(8, 4), (4, 8), (2, 2)])
+def test_pipeline_matches_sequential(devices, n_stages, num_micro):
+    mesh = Mesh(np.array(devices[:n_stages]).reshape(n_stages), ("pp",))
+    per_stage = [make_stage(jax.random.PRNGKey(i)) for i in range(n_stages)]
+    stacked = pp.stack_stage_params(per_stage)
+    x = jax.random.normal(jax.random.PRNGKey(9), (16, DIM), jnp.float32)
+
+    def spmd(x, params):
+        local = jax.tree_util.tree_map(lambda a: a[0], params)
+        return pp.pipeline_apply(
+            stage_fn, local, x, "pp", num_microbatches=num_micro
+        )
+
+    out = jax.jit(
+        jax.shard_map(spmd, mesh=mesh, in_specs=(P(), P("pp")), out_specs=P())
+    )(x, stacked)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(sequential(per_stage, x)), atol=1e-5
+    )
+
+
+def test_pipeline_gradients_match_sequential(devices):
+    n_stages, num_micro = 4, 4
+    mesh = Mesh(np.array(devices[:n_stages]).reshape(n_stages), ("pp",))
+    per_stage = [make_stage(jax.random.PRNGKey(i)) for i in range(n_stages)]
+    stacked = pp.stack_stage_params(per_stage)
+    x = jax.random.normal(jax.random.PRNGKey(9), (8, DIM), jnp.float32)
+
+    def spmd(x, params):
+        local = jax.tree_util.tree_map(lambda a: a[0], params)
+
+        def loss(x, local):
+            y = pp.pipeline_apply(
+                stage_fn, local, x, "pp", num_microbatches=num_micro
+            )
+            return jnp.sum(jnp.sin(y))
+
+        dx, dp = jax.grad(loss, argnums=(0, 1))(x, local)
+        return dx, jax.tree_util.tree_map(lambda a: a[None], dp)
+
+    # dx replicated (enforced by out_specs); dp per-stage
+    dx, dp = jax.jit(
+        jax.shard_map(
+            spmd, mesh=mesh, in_specs=(P(), P("pp")), out_specs=(P(), P("pp")),
+        )
+    )(x, stacked)
+
+    def ref_loss(x, per_stage):
+        return jnp.sum(jnp.sin(sequential(per_stage, x)))
+
+    rdx, rdp = jax.grad(ref_loss, argnums=(0, 1))(x, per_stage)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(rdx), atol=1e-5)
+    for s in range(n_stages):
+        np.testing.assert_allclose(
+            np.asarray(dp["w"][s]), np.asarray(rdp[s]["w"]), atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(dp["b"][s]), np.asarray(rdp[s]["b"]), atol=1e-5
+        )
+
+
+def test_pipeline_composes_with_gossip(devices):
+    """(dp=2, pp=4): each dp replica runs its pipeline, then the per-stage
+    params gossip over dp — one neighbor_allreduce equals W shard-wise."""
+    dp, n_stages = 2, 4
+    mesh = Mesh(np.array(devices).reshape(dp, n_stages), ("bf_nodes", "pp"))
+    topo = tu.RingGraph(dp)
+    plan = compile_plan(topo)
+    W = tu.GetWeightMatrix(topo)
+
+    per_rank = [
+        pp.stack_stage_params(
+            [make_stage(jax.random.PRNGKey(10 * r + i)) for i in range(n_stages)]
+        )
+        for r in range(dp)
+    ]
+    stacked = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *per_rank)
+    x = jax.random.normal(jax.random.PRNGKey(3), (dp, 8, DIM), jnp.float32)
+
+    def spmd(x, params):
+        local = jax.tree_util.tree_map(lambda a: a[0, 0], params)
+        y = pp.pipeline_apply(stage_fn, local, x[0], "pp", num_microbatches=2)
+        mixed = ops_spmd.neighbor_allreduce(local, plan, "bf_nodes")
+        return y[None], jax.tree_util.tree_map(lambda a: a[None, None], mixed)
+
+    y, mixed = jax.jit(
+        jax.shard_map(
+            spmd, mesh=mesh,
+            in_specs=(P("bf_nodes"), P("bf_nodes", "pp")),
+            out_specs=(P("bf_nodes"), P("bf_nodes", "pp")),
+        )
+    )(x, stacked)
+
+    for r in range(dp):
+        seq = sequential(
+            [jax.tree_util.tree_map(lambda a, i=i: a[i], per_rank[r])
+             for i in range(n_stages)],
+            x[r],
+        )
+        np.testing.assert_allclose(np.asarray(y[r]), np.asarray(seq), atol=1e-5)
+    for leaf_out, leaf_in in zip(
+        jax.tree_util.tree_leaves(mixed), jax.tree_util.tree_leaves(stacked)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(leaf_out),
+            np.einsum("ds,s...->d...", W, np.asarray(leaf_in)),
+            rtol=1e-5, atol=1e-6,
+        )
+
+
+def test_pipeline_bad_microbatch_count(devices):
+    mesh = Mesh(np.array(devices[:2]).reshape(2), ("pp",))
+    stacked = pp.stack_stage_params(
+        [make_stage(jax.random.PRNGKey(i)) for i in range(2)]
+    )
+    x = jnp.ones((10, DIM))
+
+    def spmd(x, params):
+        local = jax.tree_util.tree_map(lambda a: a[0], params)
+        return pp.pipeline_apply(stage_fn, local, x, "pp", num_microbatches=3)
+
+    with pytest.raises(ValueError):
+        jax.jit(
+            jax.shard_map(spmd, mesh=mesh, in_specs=(P(), P("pp")), out_specs=P())
+        )(x, stacked)
