@@ -1,8 +1,20 @@
-"""Shared helpers for the sequence-parallel strategies."""
+"""Shared helpers for the parallel strategies."""
 
 from __future__ import annotations
 
 from jax import lax
+
+
+def pvary(x, axis_name):
+    """Re-type a replicated value as varying over ``axis_name`` under
+    shard_map's varying-manual-axes checking, across JAX versions
+    (``pcast`` is current, ``pvary`` its deprecated predecessor, pre-vma
+    JAX needs nothing)."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_name, to="varying")
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, axis_name)
+    return x
 
 
 def resolve_axis_size(axis_name: str, axis_size) -> int:

@@ -18,6 +18,7 @@ output, the straight-through-free Switch estimator).
 
 from __future__ import annotations
 
+import math
 from typing import Optional, Tuple
 
 import jax
@@ -71,9 +72,16 @@ def switch_moe(
     n = int(resolve_axis_size(axis_name, axis_size))
     e_local = params["wi"].shape[0]
     E = n * e_local
+    if params["router"].shape[1] != E:
+        raise ValueError(
+            f"router is {params['router'].shape[1]} experts wide but "
+            f"ep={n} x {e_local} local experts = {E}; pass this device's "
+            f"[E/ep, ...] expert shard, not the full stack"
+        )
     T = x.shape[0]
-    # per-device, per-expert slot budget
-    cap = max(1, int(capacity_factor * T / E))
+    # per-device, per-expert slot budget (ceil: capacity_factor headroom
+    # must yield slots even when T/E is small)
+    cap = max(1, math.ceil(capacity_factor * T / E))
 
     logits = jnp.einsum("td,de->te", x, params["router"],
                         preferred_element_type=jnp.float32)
@@ -84,9 +92,9 @@ def switch_moe(
     onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # [T, E]
     # position of each token within its expert's slots (this device's view)
     pos = (jnp.cumsum(onehot, axis=0) * onehot - 1.0).astype(jnp.int32)
-    keep = (pos >= 0) & (pos < cap)  # [T, E]; -1 marks inactive pairs
-    slot = jax.nn.one_hot(pos, cap, dtype=jnp.float32)  # [T, E, cap]
-    dispatch = slot * keep[..., None]  # [T, E, cap] 0/1
+    # one_hot zeroes out-of-range rows, so it IS the keep mask: pos == -1
+    # (inactive pair) and pos >= cap (overflow) both yield all-zero slots
+    dispatch = jax.nn.one_hot(pos, cap, dtype=jnp.float32)  # [T, E, cap] 0/1
     combine = dispatch * gate[:, None, None]  # gradient flows to the router
 
     wdt = x.dtype

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from bluefog_tpu.parallel._util import resolve_axis_size
+from bluefog_tpu.parallel._util import pvary, resolve_axis_size
 from bluefog_tpu.parallel.tensor_parallel import reduce_from_tp_region
 
 __all__ = ["pipeline_apply", "stack_stage_params", "PP_AXIS"]
@@ -103,15 +103,9 @@ def pipeline_apply(
         state = lax.ppermute(state, axis_name, fwd_perm)
         return (state, outs), None
 
-    def pvary(a):  # scan carries become pp-varying; type the inits to match
-        if hasattr(lax, "pcast"):
-            return lax.pcast(a, axis_name, to="varying")
-        if hasattr(lax, "pvary"):
-            return lax.pvary(a, axis_name)
-        return a
-
-    state0 = pvary(jnp.zeros_like(micro[0]))
-    outs0 = pvary(jnp.zeros_like(micro))
+    # scan carries become pp-varying; type the inits to match
+    state0 = pvary(jnp.zeros_like(micro[0]), axis_name)
+    outs0 = pvary(jnp.zeros_like(micro), axis_name)
     (_, outs), _ = lax.scan(tick, (state0, outs0), jnp.arange(ticks))
     # replicate the last stage's collected outputs to every stage.  The
     # masked psum must be the g operator (identity backward): a raw psum

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from bluefog_tpu.parallel._util import pvary as _util_pvary
+
 __all__ = [
     "reduce_from_tp_region",
     "column_parallel_dense",
@@ -76,12 +78,7 @@ def _reduce_fwd(x, axis_name):
 def _reduce_bwd(axis_name, _, g):
     # the primal input is tp-varying; re-type the (replicated) cotangent to
     # match under shard_map's varying-manual-axes checking
-    if hasattr(lax, "pcast"):  # current vma-typing API
-        g = lax.pcast(g, axis_name, to="varying")
-    elif hasattr(lax, "pvary"):  # its deprecated predecessor
-        g = lax.pvary(g, axis_name)
-    # (pre-vma jax: no re-typing needed)
-    return (g,)
+    return (_util_pvary(g, axis_name),)
 
 
 reduce_from_tp_region.defvjp(_reduce_fwd, _reduce_bwd)

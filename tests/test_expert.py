@@ -86,6 +86,14 @@ def test_moe_capacity_drops_tokens():
     np.testing.assert_allclose(o[kept], ref[kept], atol=1e-5)
 
 
+def test_moe_rejects_full_stack_as_shard():
+    """Passing the full expert stack where a per-device shard belongs is a
+    trace-time error, not silently wrong routing."""
+    params = ep.init_moe_params(jax.random.PRNGKey(0), D, F, E)
+    with pytest.raises(ValueError, match="router"):
+        ep.switch_moe(jnp.ones((4, D)), params, "ep", axis_size=2)
+
+
 def test_moe_gradients_flow_to_router_and_experts(devices):
     tloc = 4
     x_all = jax.random.normal(jax.random.PRNGKey(2), (8, tloc, D), jnp.float32)
