@@ -1,0 +1,193 @@
+"""Pipeline-parallel x decentralized-gossip training: a (dp, pp) mesh where
+each gossip replica's transformer blocks are split into pipeline stages
+(GPipe microbatch streaming over ``pp``), and replicas neighbor-average all
+parameters — stage shards mix stage-wise, like tensor/expert parallelism
+(examples/jax_tp_gossip.py, jax_moe_gossip.py; PP absent upstream,
+SURVEY.md §2.3).
+
+Embedding/unembedding stay outside the pipeline (replicated over pp, so
+they enter shard_map pp-INVARIANT per the split layout rule); the pipeline
+carries the residual stream through ``layers/pp`` blocks per stage.
+Ground truth: a pp=N run matches pp=1 loss-for-loss.
+
+Run (CPU mesh): JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/jax_pp_gossip.py --steps 30
+"""
+
+import argparse
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bluefog_tpu import ops_spmd
+from bluefog_tpu import topology_util as tu
+from bluefog_tpu.core.plan import compile_plan
+from bluefog_tpu.models.transformer import dense_attention
+from bluefog_tpu.parallel import pipeline as ppx
+
+VOCAB = 64
+
+
+def init_block(key, d_model, heads):
+    dh = d_model // heads
+    ks = jax.random.split(key, 6)
+
+    def dense(k, shape, fan):
+        return jax.random.normal(k, shape, jnp.float32) / np.sqrt(fan)
+
+    return {
+        "wq": dense(ks[0], (d_model, heads, dh), d_model),
+        "wk": dense(ks[1], (d_model, heads, dh), d_model),
+        "wv": dense(ks[2], (d_model, heads, dh), d_model),
+        "wo": dense(ks[3], (heads, dh, d_model), d_model),
+        "wi": dense(ks[4], (d_model, 4 * d_model), d_model),
+        "wd": dense(ks[5], (4 * d_model, d_model), 4 * d_model),
+        "norm1": jnp.ones((d_model,)),
+        "norm2": jnp.ones((d_model,)),
+    }
+
+
+def rms(x, scale, eps=1e-6):
+    y = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    return y * scale
+
+
+def block_apply(blk, x):
+    """One transformer block on [B, T, d] (a stage applies a stack)."""
+    h = rms(x, blk["norm1"])
+    q = jnp.einsum("btm,mhd->bthd", h, blk["wq"])
+    k = jnp.einsum("btm,mhd->bthd", h, blk["wk"])
+    v = jnp.einsum("btm,mhd->bthd", h, blk["wv"])
+    att = dense_attention(q, k, v, causal=True, dtype=x.dtype)
+    x = x + jnp.einsum("bthd,hdm->btm", att, blk["wo"])
+    h = rms(x, blk["norm2"])
+    return x + jax.nn.gelu(h @ blk["wi"]) @ blk["wd"]
+
+
+def stage_fn(stage_params, x):
+    """stage_params: blocks stacked on axis 0 ([k, ...] leaves)."""
+    k = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+    for i in range(k):
+        blk = jax.tree_util.tree_map(lambda a, i=i: a[i], stage_params)
+        x = block_apply(blk, x)
+    return x
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--dp", type=int, default=2)
+    ap.add_argument("--pp", type=int, default=4)
+    ap.add_argument("--d-model", type=int, default=32)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=8, help="sequences per replica")
+    ap.add_argument("--microbatches", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+
+    devices = jax.devices()
+    need = args.dp * args.pp
+    if len(devices) < need:
+        raise SystemExit(
+            f"need {need} devices (dp={args.dp} x pp={args.pp}), have "
+            f"{len(devices)}"
+        )
+    if args.layers % args.pp or args.batch % args.microbatches:
+        raise SystemExit(
+            "--layers must divide by --pp and --batch by --microbatches"
+        )
+    mesh = Mesh(np.array(devices[:need]).reshape(args.dp, args.pp),
+                ("bf_nodes", "pp"))
+    plan = compile_plan(tu.ExponentialTwoGraph(args.dp))
+    k = args.layers // args.pp  # blocks per stage
+
+    per_repl, per_stage = [], []
+    for r in range(args.dp):
+        ks = jax.random.split(jax.random.PRNGKey(r), args.layers + 2)
+        blocks = [init_block(ks[i], args.d_model, args.heads)
+                  for i in range(args.layers)]
+        per_repl.append({
+            "embed": jax.random.normal(ks[-2], (VOCAB, args.d_model)) * 0.3,
+            "unembed": jax.random.normal(ks[-1], (args.d_model, VOCAB))
+            / np.sqrt(args.d_model),
+        })
+        # stage s owns blocks [s*k, (s+1)*k)
+        per_stage.append(ppx.stack_stage_params([
+            ppx.stack_stage_params(blocks[s * k:(s + 1) * k])
+            for s in range(args.pp)
+        ]))
+    stack = lambda *ls: jnp.stack(ls)
+    repl = jax.tree_util.tree_map(stack, *per_repl)
+    stages = jax.tree_util.tree_map(stack, *per_stage)
+    opt = optax.sgd(args.lr, momentum=0.9)
+    opt_r = jax.tree_util.tree_map(stack, *[opt.init(p) for p in per_repl])
+    opt_s = jax.tree_util.tree_map(stack, *[opt.init(p) for p in per_stage])
+
+    def loss_fn(pr, ps, ids):
+        x = pr["embed"][ids[:, :-1]]
+        y = ppx.pipeline_apply(
+            stage_fn, ps, x, "pp", num_microbatches=args.microbatches
+        )
+        logits = jnp.einsum("btm,mv->btv", y, pr["unembed"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, ids[:, 1:]
+        ).mean()
+
+    def spmd_step(repl, stages, opt_r, opt_s, ids):
+        t1 = functools.partial(jax.tree_util.tree_map, lambda a: a[0])
+        t2 = functools.partial(jax.tree_util.tree_map, lambda a: a[0, 0])
+        pr, ps, sr, ss = t1(repl), t2(stages), t1(opt_r), t2(opt_s)
+        loss, (gr, gs) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+            pr, ps, ids[0]
+        )
+        ur, sr = opt.update(gr, sr, pr)
+        pr = optax.apply_updates(pr, ur)
+        us, ss = opt.update(gs, ss, ps)
+        ps = optax.apply_updates(ps, us)
+        pr = ops_spmd.neighbor_allreduce(pr, plan, "bf_nodes")
+        ps = ops_spmd.neighbor_allreduce(ps, plan, "bf_nodes")
+        e1 = functools.partial(jax.tree_util.tree_map, lambda a: a[None])
+        e2 = functools.partial(jax.tree_util.tree_map, lambda a: a[None, None])
+        loss = jax.lax.pmean(loss, "bf_nodes")[None]
+        return e1(pr), e2(ps), e1(sr), e2(ss), loss
+
+    step = jax.jit(
+        jax.shard_map(
+            spmd_step, mesh=mesh,
+            in_specs=(P("bf_nodes"), P("bf_nodes", "pp"), P("bf_nodes"),
+                      P("bf_nodes", "pp"), P("bf_nodes")),
+            out_specs=(P("bf_nodes"), P("bf_nodes", "pp"), P("bf_nodes"),
+                       P("bf_nodes", "pp"), P("bf_nodes")),
+        )
+    )
+
+    rng = np.random.default_rng(0)
+
+    def batch():
+        start = rng.integers(0, VOCAB, size=(args.dp, args.batch, 1))
+        ids = (start + np.arange(args.seq + 1)) % VOCAB
+        return jnp.asarray(ids, jnp.int32)
+
+    for i in range(args.steps):
+        repl, stages, opt_r, opt_s, loss = step(
+            repl, stages, opt_r, opt_s, batch()
+        )
+        if (i + 1) % 10 == 0 or i == 0:
+            w = np.asarray(stages["wq"])
+            spread = float(np.abs(w - w.mean(axis=0, keepdims=True)).max())
+            print(
+                f"step {i + 1:3d}: loss {float(np.asarray(loss).mean()):.4f} "
+                f"consensus-spread {spread:.2e}"
+            )
+
+    print(f"done: dp={args.dp} pp={args.pp} on {need} devices")
+
+
+if __name__ == "__main__":
+    main()
