@@ -1,0 +1,99 @@
+"""Attention kernel benchmark: the Pallas flash kernel vs dense XLA
+attention across sequence lengths (the hot op of the transformer configs —
+BASELINE configs #3/#5; kernel in ``bluefog_tpu/kernels/flash_attention.py``).
+
+Run (TPU):      python benchmarks/attention.py
+Run (CPU mesh): JAX_PLATFORMS=cpu python benchmarks/attention.py --seqs 256
+
+Prints ONE JSON line: value = flash fwd+bwd TFLOP/s at the largest
+sequence, vs_baseline = dense time / flash time there (>1: flash faster).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+jax.config.update("jax_compilation_cache_dir", "/tmp/bluefog_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import _sync
+from bluefog_tpu.kernels.flash_attention import flash_attention
+from bluefog_tpu.models.transformer import dense_attention
+
+
+def timed(f, args, iters):
+    out = f(*args)
+    _sync(out[0] if isinstance(out, tuple) else out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(*args)
+    _sync(out[0] if isinstance(out, tuple) else out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--head-dim", type=int, default=128)
+    ap.add_argument("--seqs", type=int, nargs="*", default=None)
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    seqs = args.seqs or ([1024, 2048, 4096, 8192] if on_tpu else [256])
+    B, H, D = args.batch, args.heads, args.head_dim
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+
+    # [B, T, H, D] layout (the models' convention)
+    def qkv(S):
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        return tuple(jax.random.normal(k, (B, S, H, D), dtype) for k in ks)
+
+    def flash_loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True).astype(jnp.float32))
+
+    def dense_loss(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=True,
+                                       dtype=dtype).astype(jnp.float32))
+
+    flash_g = jax.jit(jax.grad(flash_loss, argnums=(0, 1, 2)))
+    dense_g = jax.jit(jax.grad(dense_loss, argnums=(0, 1, 2)))
+
+    result = None
+    for S in seqs:
+        tf = timed(flash_g, qkv(S), args.iters)
+        try:
+            td = timed(dense_g, qkv(S), args.iters)
+        except Exception:  # dense OOMs first at long S — that's the point
+            td = float("inf")
+        # causal fwd+bwd useful FLOPs: (4 qk/pv + 2x4 bwd) * 0.5 causal
+        flops = 12 * B * H * S * S * D * 0.5
+        print(
+            f"# S={S}: flash {tf * 1e3:8.2f} ms  dense {td * 1e3:8.2f} ms  "
+            f"({flops / tf / 1e12:5.1f} TF/s, dense/flash {td / tf:4.2f}x)",
+            file=sys.stderr,
+        )
+        result = {
+            "metric": f"flash attention fwd+bwd TFLOP/s "
+                      f"(B{B} H{H} S{S} D{D} causal {jnp.dtype(dtype).name})",
+            "value": round(flops / tf / 1e12, 2),
+            "unit": "TFLOP/s",
+            "vs_baseline": round(td / tf, 4) if np.isfinite(td) else None,
+        }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
