@@ -1,0 +1,114 @@
+"""Llama-style decentralized pretraining throughput, tokens/sec/chip —
+evidence for BASELINE config #5 (Llama gossip pretraining) at a
+single-chip-sized model.  Same harness conventions as bench.py (the driver
+metric): decentralized ATC step with the exp-2 plan, global-allreduce
+baseline phase for vs_baseline, one JSON line.
+
+Run (TPU):      python benchmarks/llama.py            (~125M params, S=2048)
+Run (CPU mesh): JAX_PLATFORMS=cpu python benchmarks/llama.py --preset tiny
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+jax.config.update("jax_compilation_cache_dir", "/tmp/bluefog_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import _sync
+import bluefog_tpu as bf
+from bluefog_tpu import topology_util
+from bluefog_tpu.core import basics
+from bluefog_tpu.kernels import make_flash_attention_fn
+from bluefog_tpu.models.transformer import LlamaLM
+from bluefog_tpu.optim import CommunicationType
+from bluefog_tpu.training import make_decentralized_train_step, replicate_for_mesh
+
+PRESETS = {
+    # ~125M-class: GPT-2-small-shaped Llama, flash attention
+    "small": dict(vocab=32000, hidden=768, layers=12, heads=12, dff=2048,
+                  seq=2048, batch=8),
+    "tiny": dict(vocab=256, hidden=64, layers=2, heads=4, dff=128,
+                 seq=128, batch=2),
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    ap.add_argument("--preset", default="small" if on_tpu else "tiny",
+                    choices=sorted(PRESETS))
+    ap.add_argument("--iters", type=int, default=10 if on_tpu else 3)
+    ap.add_argument("--warmup", type=int, default=2)
+    args = ap.parse_args()
+    cfg = PRESETS[args.preset]
+
+    bf.init()
+    n = bf.size()
+    bf.set_topology(topology_util.ExponentialTwoGraph(n))
+    ctx = basics.context()
+
+    model = LlamaLM(
+        vocab_size=cfg["vocab"], hidden_size=cfg["hidden"],
+        num_layers=cfg["layers"], num_heads=cfg["heads"], dff=cfg["dff"],
+        attention_fn=make_flash_attention_fn() if on_tpu else None,
+    )
+    B, T = cfg["batch"], cfg["seq"]
+    ids0 = jnp.ones((B, T), jnp.int32)
+    params = replicate_for_mesh(model.init(jax.random.PRNGKey(0), ids0)["params"], n)
+    n_params = sum(np.prod(a.shape) for a in jax.tree_util.tree_leaves(params)) // n
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg["vocab"], size=(n, B, T)), jnp.int32)
+
+    def lm_loss(logits, labels):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], labels[:, 1:]
+        ).mean()
+
+    def lm_apply(variables, x):
+        return model.apply(variables, x)
+
+    def timed(comm, plan):
+        init_fn, step_fn = make_decentralized_train_step(
+            lm_apply, optax.adamw(3e-4), ctx.mesh,
+            communication_type=comm, plan=plan, loss_fn=lm_loss,
+        )
+        p = jax.tree_util.tree_map(jnp.copy, params)
+        opt_state = init_fn(p)
+        loss = None
+        for _ in range(args.warmup):
+            p, _, opt_state, loss, _ = step_fn(p, {}, opt_state, ids, ids)
+        _sync(loss)
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            p, _, opt_state, loss, _ = step_fn(p, {}, opt_state, ids, ids)
+        _sync(loss)
+        return (time.perf_counter() - t0) / args.iters
+
+    t_dec = timed(CommunicationType.neighbor_allreduce, ctx.plan)
+    t_ar = timed(CommunicationType.allreduce, None)
+
+    toks = B * T / t_dec
+    print(json.dumps({
+        "metric": f"Llama-{args.preset} ({n_params/1e6:.0f}M) tokens/sec/chip "
+                  f"(neighbor_allreduce exp2, S={T})",
+        "value": round(toks, 1),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(t_ar / t_dec, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
