@@ -102,6 +102,27 @@ def _declare_abi(lib: ctypes.CDLL) -> None:
             ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int64),
         ]
+        # data loader ABI
+        lib.bf_loader_create.restype = ctypes.c_void_p
+        lib.bf_loader_create.argtypes = [
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_uint64,
+            ctypes.c_char_p,
+        ]
+        lib.bf_loader_next.restype = ctypes.c_void_p
+        lib.bf_loader_next.argtypes = [ctypes.c_void_p]
+        lib.bf_loader_release.restype = None
+        lib.bf_loader_release.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.bf_loader_stats.restype = None
+        lib.bf_loader_stats.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.bf_loader_destroy.restype = None
+        lib.bf_loader_destroy.argtypes = [ctypes.c_void_p]
         # layout optimizer ABI
         lib.bf_layout_anneal.restype = ctypes.c_double
         lib.bf_layout_anneal.argtypes = [

@@ -46,6 +46,8 @@ def main():
     parser.add_argument("--warmup", type=int, default=5)
     parser.add_argument("--topology", default="exp2", choices=sorted(TOPOS))
     parser.add_argument("--mode", default="neighbor_allreduce", choices=sorted(MODES))
+    parser.add_argument("--loader", default="host", choices=["host", "native"],
+                        help="native = C++ prefetching data pipeline")
     args = parser.parse_args()
 
     bf.init()
@@ -68,8 +70,24 @@ def main():
     params = replicate_for_mesh(variables["params"], n)
     bstats = replicate_for_mesh(variables["batch_stats"], n)
     rng = np.random.default_rng(0)
-    batch = jnp.asarray(rng.normal(size=(n, bsz, img, img, 3)).astype(np.float32))
     labels = jnp.asarray(rng.integers(0, 10, size=(n, bsz)), jnp.int32)
+    loader = None
+    if args.loader == "native":
+        # C++ worker threads prefetch batches, overlapping with compute
+        from bluefog_tpu.native.data_native import NativeDataLoader
+
+        loader = NativeDataLoader((n, bsz, img, img, 3), depth=4, workers=2)
+
+        def next_batch():
+            # zero-copy view straight to device: jnp.asarray copies once
+            with loader.next_view() as v:
+                return jnp.asarray(v)
+    else:
+        fixed = jnp.asarray(
+            rng.normal(size=(n, bsz, img, img, 3)).astype(np.float32)
+        )
+        next_batch = lambda: fixed
+    batch = next_batch()
 
     comm = MODES[args.mode]
     mesh = ctx.hier_mesh if args.mode == "hierarchical" else ctx.mesh
@@ -94,9 +112,14 @@ def main():
     sync(loss)
     t0 = time.perf_counter()
     for _ in range(args.iters):
+        batch = next_batch()
         params, bstats, state, loss, _ = step_fn(params, bstats, state, batch, labels)
     sync(loss)
     dt = (time.perf_counter() - t0) / args.iters
+    if loader is not None:
+        produced, consumed, stalls = loader.stats()
+        print(f"native loader: {produced} produced, {stalls} consumer stalls")
+        loader.close()
     total = n * bsz / dt
     print(
         f"model={args.model} topology={args.topology} mode={args.mode} "
