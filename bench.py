@@ -135,8 +135,8 @@ def main():
 
     # global-allreduce baseline (the reference point).  On a single chip the
     # exp2 plan has no neighbors, so both phases run the same computation and
-    # the honest ratio is ~1; if the budget is spent, report that identity
-    # instead of timing the second compile.
+    # the honest ratio is ~1; if the budget is spent, skip further timing
+    # rather than produce nothing.
     if n == 1 and time.perf_counter() - t_start > budget_s:
         t_ar = t_dec
     else:
@@ -147,6 +147,17 @@ def main():
         t_ar = time_steps(
             step_ar, params, batch_stats, os_ar, batch, labels, warmup, iters
         )
+        # extra interleaved passes per phase (compiles cached, ~seconds
+        # each): taking mins cancels most machine-noise drift in the ratio
+        for _ in range(2):
+            if time.perf_counter() - t_start > budget_s:
+                break
+            t_dec = min(t_dec, time_steps(
+                step_dec, params, batch_stats, os_dec, batch, labels, 1, iters
+            ))
+            t_ar = min(t_ar, time_steps(
+                step_ar, params, batch_stats, os_ar, batch, labels, 1, iters
+            ))
 
     imgs_per_sec_chip = per_rank_batch / t_dec  # per-rank == per-chip
     ratio = t_ar / t_dec  # >1 means gossip step is faster than allreduce

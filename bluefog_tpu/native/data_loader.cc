@@ -17,12 +17,14 @@
 // batches arrive in index order, with several the order is unspecified
 // (exactly torch DataLoader's worker semantics).
 
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
-#include <fstream>
-#include <iterator>
 #include <mutex>
 #include <queue>
 #include <thread>
@@ -40,7 +42,8 @@ struct Loader {
   uint64_t batch_bytes = 0;
   int mode = 0;
   uint64_t seed = 0;
-  std::vector<uint8_t> file;  // mode 1
+  int fd = -1;                // mode 1: dataset file, read via pread
+  uint64_t file_batches = 0;  // whole batches in the file
   std::vector<Buffer*> pool;  // free buffers
   std::queue<Buffer*> ready;
   std::unordered_map<const uint8_t*, Buffer*> by_ptr;
@@ -69,10 +72,20 @@ void fill(Loader* L, Buffer* b) {
       f[i] = static_cast<float>(splitmix(s) >> 40) * (1.0f / 16777216.0f);
   } else {
     // wrap on whole batches so offsets stay batch- (and element-) aligned;
-    // a trailing partial batch is dropped, as dataset epochs usually do
-    size_t num_batches = L->file.size() / L->batch_bytes;
-    size_t off = (b->index % num_batches) * L->batch_bytes;
-    std::memcpy(b->data.data(), L->file.data() + off, L->batch_bytes);
+    // a trailing partial batch is dropped, as dataset epochs usually do.
+    // pread: O(batch) memory, thread-safe on a shared fd.
+    off_t off = static_cast<off_t>((b->index % L->file_batches) *
+                                   L->batch_bytes);
+    size_t done = 0;
+    while (done < L->batch_bytes) {
+      ssize_t r = pread(L->fd, b->data.data() + done, L->batch_bytes - done,
+                        off + static_cast<off_t>(done));
+      if (r <= 0) {  // IO error: surface as an obviously-poisoned batch
+        std::memset(b->data.data() + done, 0xFF, L->batch_bytes - done);
+        break;
+      }
+      done += static_cast<size_t>(r);
+    }
   }
 }
 
@@ -90,9 +103,9 @@ void worker_loop(Loader* L) {
     fill(L, b);
     {
       std::lock_guard<std::mutex> lk(L->mu);
+      L->produced.fetch_add(1);  // before push: stats never show consumed>produced
       L->ready.push(b);
     }
-    L->produced.fetch_add(1);
     L->cv_ready.notify_one();
   }
 }
@@ -109,17 +122,15 @@ void* bf_loader_create(int64_t batch_bytes, int64_t depth, int64_t workers,
   L->mode = static_cast<int>(mode);
   L->seed = seed;
   if (mode == 1) {
-    std::ifstream f(path ? path : "", std::ios::binary);
-    if (!f) {
+    L->fd = open(path ? path : "", O_RDONLY);
+    struct stat st;
+    if (L->fd < 0 || fstat(L->fd, &st) != 0 ||
+        static_cast<uint64_t>(st.st_size) < L->batch_bytes) {
+      if (L->fd >= 0) close(L->fd);
       delete L;
       return nullptr;
     }
-    L->file.assign(std::istreambuf_iterator<char>(f),
-                   std::istreambuf_iterator<char>());
-    if (L->file.size() < L->batch_bytes) {
-      delete L;
-      return nullptr;
-    }
+    L->file_batches = static_cast<uint64_t>(st.st_size) / L->batch_bytes;
   }
   for (int64_t i = 0; i < depth; ++i) {
     auto* b = new Buffer();
@@ -138,7 +149,8 @@ const uint8_t* bf_loader_next(void* h) {
   {
     std::unique_lock<std::mutex> lk(L->mu);
     if (L->ready.empty()) L->stalls.fetch_add(1);
-    L->cv_ready.wait(lk, [&] { return !L->ready.empty(); });
+    L->cv_ready.wait(lk, [&] { return L->stop || !L->ready.empty(); });
+    if (L->ready.empty()) return nullptr;  // loader shut down
     b = L->ready.front();
     L->ready.pop();
   }
@@ -171,8 +183,10 @@ void bf_loader_destroy(void* h) {
     L->stop = true;
   }
   L->cv_free.notify_all();
+  L->cv_ready.notify_all();  // wake any consumer blocked in next()
   for (auto& t : L->workers) t.join();
   for (auto& kv : L->by_ptr) delete kv.second;
+  if (L->fd >= 0) close(L->fd);
   delete L;
 }
 

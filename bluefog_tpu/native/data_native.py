@@ -59,6 +59,8 @@ class NativeDataLoader:
     @contextlib.contextmanager
     def next_view(self) -> Iterator[np.ndarray]:
         ptr = self._lib.bf_loader_next(self._h)
+        if not ptr:
+            raise RuntimeError("loader was shut down")
         try:
             raw = np.ctypeslib.as_array(
                 ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8)),

@@ -77,11 +77,19 @@ def main():
         from bluefog_tpu.native.data_native import NativeDataLoader
 
         loader = NativeDataLoader((n, bsz, img, img, 3), depth=4, workers=2)
+        # zero-copy is only safe where the device copy provably completes
+        # before the ring buffer is released: block_until_ready is reliable
+        # on real cpu/tpu backends but a no-op on the tunneled axon platform,
+        # and the CPU backend may alias host memory — so copy there.
+        zero_copy = jax.devices()[0].platform == "tpu"
 
         def next_batch():
-            # zero-copy view straight to device: jnp.asarray copies once
-            with loader.next_view() as v:
-                return jnp.asarray(v)
+            if zero_copy:
+                with loader.next_view() as v:
+                    arr = jax.device_put(v)
+                    arr.block_until_ready()
+                    return arr
+            return jnp.asarray(loader.next())
     else:
         fixed = jnp.asarray(
             rng.normal(size=(n, bsz, img, img, 3)).astype(np.float32)
