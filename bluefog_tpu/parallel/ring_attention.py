@@ -101,13 +101,16 @@ def ring_flash_attention(
     block_q: int = 256,
     block_k: int = 256,
     interpret: bool = None,
+    impl: str = "auto",
 ) -> jnp.ndarray:
-    """Ring attention with the Pallas flash kernel as the per-hop compute.
+    """Ring attention with blockwise flash attention as the per-hop compute.
 
     Same semantics/layout as :func:`ring_attention`, but each hop runs
     :func:`bluefog_tpu.kernels.flash_attention_with_lse` — MXU-blocked,
     O(T_local·block) memory instead of materializing the [Tq, Tk] score
-    matrix — and hops merge by the logsumexp rule.  Differentiable end to
+    matrix — and hops merge by the logsumexp rule.  ``impl`` selects the
+    per-hop implementation (default "auto": XLA blockwise when compiled,
+    the Pallas kernel in interpret mode; "pallas" forces the kernel).  Differentiable end to
     end (the kernel's VJP carries the lse cotangent the merge needs).
 
     Note: when running the kernel in *interpret mode* (CPU testing), the
@@ -132,7 +135,7 @@ def ring_flash_attention(
             q, kb, vb,
             q_start=idx * tq, k_start=j * tk,
             causal=causal, block_q=block_q, block_k=block_k,
-            interpret=interpret,
+            interpret=interpret, impl=impl,
         )
         o_s = o_s.astype(jnp.float32)
         if o is None:

@@ -54,6 +54,7 @@ def ulysses_attention(
     block_q: int = 256,
     block_k: int = 256,
     interpret: bool = None,
+    impl: str = "auto",
 ) -> jnp.ndarray:
     """Exact attention across sequence shards via head re-sharding.
 
@@ -92,7 +93,7 @@ def ulysses_attention(
 
         out = flash_attention(
             qg, kg, vg, causal=causal,
-            block_q=block_q, block_k=block_k, interpret=interpret,
+            block_q=block_q, block_k=block_k, interpret=interpret, impl=impl,
         )
     else:
         from bluefog_tpu.models.transformer import dense_attention
