@@ -98,7 +98,7 @@ def main():
     n = len(jax.devices())
     on_tpu = platform == "tpu"
     per_rank_batch = int(os.environ.get("BENCH_BATCH", 128 if on_tpu else 2))
-    iters = int(os.environ.get("BENCH_STEPS", 10 if on_tpu else 3))
+    iters = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 3))
     warmup = int(os.environ.get("BENCH_WARMUP", 2 if on_tpu else 1))
     # wall-clock guard: if the decentralized phase ate the budget (slow
     # remote compile), skip the baseline phase rather than produce nothing
