@@ -125,18 +125,49 @@ def ring_flash_attention(
     idx = lax.axis_index(axis_name)
     perm = tuple((i, (i + 1) % n) for i in range(n))
 
+    def flash(q_, kb_, vb_, *, q_start, k_start, causal_):
+        return flash_attention_with_lse(
+            q_, kb_, vb_, q_start=q_start, k_start=k_start, causal=causal_,
+            block_q=block_q, block_k=block_k, interpret=interpret, impl=impl,
+        )
+
+    def masked_hop(ops):
+        q_, _, _ = ops
+        b, t, h, _ = q_.shape
+        return (jnp.zeros(q_.shape, q_.dtype),
+                jnp.full((b, h, t), -1e30, jnp.float32))
+
+    def diag_hop(ops):
+        # q_start == k_start: relative masking suffices, and static zero
+        # offsets unlock the aligned triangular fast paths
+        return flash(*ops, q_start=0, k_start=0, causal_=True)
+
+    def visible_hop(ops):
+        return flash(*ops, q_start=0, k_start=0, causal_=False)
+
     o = None
     lse = None
     kv = (k, v)
     for step in range(n):
         kb, vb = kv
         j = (idx - step) % n  # global index of the key block held this step
-        o_s, lse_s = flash_attention_with_lse(
-            q, kb, vb,
-            q_start=idx * tq, k_start=j * tk,
-            causal=causal, block_q=block_q, block_k=block_k,
-            interpret=interpret, impl=impl,
-        )
+        if causal and tq == tk:
+            # hop-level causal dispatch: key blocks after this device's
+            # query block contribute nothing (skip the compute entirely),
+            # earlier blocks are fully visible (mask-free kernel), only the
+            # diagonal needs element masking — the classic halve-the-work
+            # fix for causal ring attention.  j == idx iff step == 0 and
+            # j > idx iff step > idx, so the diagonal resolves statically.
+            if step == 0:
+                o_s, lse_s = diag_hop((q, kb, vb))
+            else:
+                o_s, lse_s = lax.cond(
+                    step > idx, masked_hop, visible_hop, (q, kb, vb)
+                )
+        else:
+            o_s, lse_s = flash(
+                q, kb, vb, q_start=idx * tq, k_start=j * tk, causal_=causal
+            )
         o_s = o_s.astype(jnp.float32)
         if o is None:
             o, lse = o_s, lse_s
