@@ -245,9 +245,11 @@ def _blockwise_fwd_xla(q, k, v, q_start, k_start, *, scale, causal, block_k,
 
     if _use_triangular(causal, aligned, tq, tk, num_k):
         # triangular unroll: k block j touches only q rows >= j*block_k
-        o = jnp.zeros(q.shape, jnp.float32)
-        m = jnp.full((bh, tq, 1), _NEG_INF, jnp.float32)
-        l = jnp.zeros((bh, tq, 1), jnp.float32)
+        # (inits derived from q: vma-typed like the updates, cf. fori path)
+        o = q.astype(jnp.float32) * 0.0
+        zcol = o.sum(-1, keepdims=True)
+        m = zcol + _NEG_INF
+        l = zcol
         for j in range(num_k):
             r0 = j * block_k
             kb, vb = k[:, r0:r0 + block_k], v[:, r0:r0 + block_k]
@@ -283,12 +285,13 @@ def _blockwise_fwd_xla(q, k, v, q_start, k_start, *, scale, causal, block_k,
             o = o * alpha + f32("bqk,bkd->bqd", p.astype(v.dtype), vb)
             return o, m_new, l
 
+        # carries derived from q so their varying-manual-axes type matches
+        # the body's outputs under shard_map's vma checking
+        zcol = q.astype(jnp.float32).sum(-1, keepdims=True) * 0.0
         o, m, l = lax.fori_loop(
             0, num_k,
             body,
-            (q.astype(jnp.float32) * 0.0,
-             jnp.full((bh, tq, 1), _NEG_INF, jnp.float32),
-             jnp.zeros((bh, tq, 1), jnp.float32)),
+            (q.astype(jnp.float32) * 0.0, zcol + _NEG_INF, zcol),
         )
 
     out = (o / jnp.maximum(l, 1e-30)).astype(q.dtype)

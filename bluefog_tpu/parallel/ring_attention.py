@@ -34,6 +34,18 @@ __all__ = [
 ]
 
 
+def _causal_hop_dispatch(step, idx, diag_fn, visible_fn, masked_fn, ops):
+    """Hop-level causal dispatch, shared by both ring variants: with square
+    blocks, the block held at ring step ``s`` has global index ``j = (idx -
+    s) mod n``, so ``j == idx`` iff ``s == 0`` (the diagonal, needs element
+    masking) and ``j > idx`` iff ``s > idx`` (fully masked — skip the
+    compute); every other hop is fully visible (mask-free).  The classic
+    halve-the-work fix for causal ring attention."""
+    if step == 0:
+        return diag_fn(ops)
+    return lax.cond(step > idx, masked_fn, visible_fn, ops)
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -60,19 +72,12 @@ def ring_attention(
     o = jnp.zeros((B, Tq, H, D), jnp.float32)
     perm = tuple((i, (i + 1) % n) for i in range(n))
 
-    kv = (k.astype(jnp.float32), v.astype(jnp.float32))
-    for step in range(n):
-        kb, vb = kv
-        j = (idx - step) % n  # which global block this device holds now
+    def fold_block(m, l, o, kb, vb, valid):
+        """Online-softmax update of (m, l, o) with one key block."""
         scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kb) * scale
-        if causal:
-            gq = idx * Tq + jnp.arange(Tq)  # global query positions
-            gk = j * Tk + jnp.arange(Tk)  # global key positions
-            mask = gk[None, :] <= gq[:, None]  # [Tq, Tk]
-            valid = mask[None, None]
-        else:
-            valid = jnp.ones((1, 1, Tq, Tk), bool)
-        m_new = jnp.maximum(m, jnp.max(jnp.where(valid, scores, -jnp.inf), axis=-1))
+        m_new = jnp.maximum(
+            m, jnp.max(jnp.where(valid, scores, -jnp.inf), axis=-1)
+        )
         # keep m finite where nothing has been seen yet (fully masked rows)
         m_new = jnp.where(jnp.isfinite(m_new), m_new, m)
         alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new), 0.0)  # [B,H,Tq]
@@ -82,7 +87,31 @@ def ring_attention(
         o = o * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
             "bhqk,bkhd->bqhd", p, vb
         )
-        m = m_new
+        return m_new, l, o
+
+    all_valid = jnp.ones((1, 1, Tq, Tk), bool)
+    kv = (k.astype(jnp.float32), v.astype(jnp.float32))
+    for step in range(n):
+        kb, vb = kv
+        j = (idx - step) % n  # which global block this device holds now
+        if causal and Tq == Tk:
+            tri = (jnp.arange(Tk)[None, :]
+                   <= jnp.arange(Tq)[:, None])[None, None]
+            m, l, o = _causal_hop_dispatch(
+                step, idx,
+                lambda ops: fold_block(*ops, tri),
+                lambda ops: fold_block(*ops, all_valid),
+                lambda ops: ops[:3],
+                (m, l, o, kb, vb),
+            )
+        else:
+            if causal:
+                gq = idx * Tq + jnp.arange(Tq)  # global query positions
+                gk = j * Tk + jnp.arange(Tk)  # global key positions
+                valid = (gk[None, :] <= gq[:, None])[None, None]
+            else:
+                valid = all_valid
+            m, l, o = fold_block(m, l, o, kb, vb, valid)
         if step != n - 1:
             kv = lax.ppermute(kv, axis_name, perm)
 
@@ -132,10 +161,12 @@ def ring_flash_attention(
         )
 
     def masked_hop(ops):
+        # sentinels derived from the operands so their varying-manual-axes
+        # type matches the compute branches under shard_map's vma checking
         q_, _, _ = ops
-        b, t, h, _ = q_.shape
-        return (jnp.zeros(q_.shape, q_.dtype),
-                jnp.full((b, h, t), -1e30, jnp.float32))
+        zero = q_.astype(jnp.float32) * 0.0
+        return (zero.astype(q_.dtype),
+                zero.sum(-1).transpose(0, 2, 1) - 1e30)
 
     def diag_hop(ops):
         # q_start == k_start: relative masking suffices, and static zero
@@ -152,18 +183,9 @@ def ring_flash_attention(
         kb, vb = kv
         j = (idx - step) % n  # global index of the key block held this step
         if causal and tq == tk:
-            # hop-level causal dispatch: key blocks after this device's
-            # query block contribute nothing (skip the compute entirely),
-            # earlier blocks are fully visible (mask-free kernel), only the
-            # diagonal needs element masking — the classic halve-the-work
-            # fix for causal ring attention.  j == idx iff step == 0 and
-            # j > idx iff step > idx, so the diagonal resolves statically.
-            if step == 0:
-                o_s, lse_s = diag_hop((q, kb, vb))
-            else:
-                o_s, lse_s = lax.cond(
-                    step > idx, masked_hop, visible_hop, (q, kb, vb)
-                )
+            o_s, lse_s = _causal_hop_dispatch(
+                step, idx, diag_hop, visible_hop, masked_hop, (q, kb, vb)
+            )
         else:
             o_s, lse_s = flash(
                 q, kb, vb, q_start=idx * tq, k_start=j * tk, causal_=causal

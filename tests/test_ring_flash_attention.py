@@ -45,6 +45,31 @@ def _ring_fn(mesh, causal):
 
 
 @pytest.mark.parametrize("causal", [True, False])
+def test_ring_flash_xla_impl_under_default_vma(causal, devices):
+    """The compiled (impl="xla") ring path must trace under shard_map's
+    DEFAULT vma checking — regression: hop sentinels and fori carries were
+    unvarying-typed and failed check_vma=True."""
+    from bluefog_tpu.core import basics
+
+    mesh = basics.context().mesh
+    q, k, v = _qkv(jax.random.PRNGKey(5))
+    out = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ring_flash_attention(
+                q, k, v, NODES_AXIS, SIZE, causal=causal,
+                block_q=4, block_k=4, interpret=False, impl="xla",
+            ),
+            mesh=mesh,
+            in_specs=P(None, NODES_AXIS),
+            out_specs=P(None, NODES_AXIS),
+            # default check_vma (True)
+        )
+    )(q, k, v)
+    ref = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
 def test_ring_flash_matches_dense(causal):
     from bluefog_tpu.core import basics
 
