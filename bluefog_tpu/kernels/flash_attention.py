@@ -49,6 +49,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from bluefog_tpu.parallel._util import vma_full
+
 __all__ = ["flash_attention", "flash_attention_with_lse", "make_flash_attention_fn"]
 
 _NEG_INF = -1e30  # finite mask sentinel (real scores can never reach it)
@@ -245,11 +247,9 @@ def _blockwise_fwd_xla(q, k, v, q_start, k_start, *, scale, causal, block_k,
 
     if _use_triangular(causal, aligned, tq, tk, num_k):
         # triangular unroll: k block j touches only q rows >= j*block_k
-        # (inits derived from q: vma-typed like the updates, cf. fori path)
-        o = q.astype(jnp.float32) * 0.0
-        zcol = o.sum(-1, keepdims=True)
-        m = zcol + _NEG_INF
-        l = zcol
+        o = vma_full(q, q.shape, jnp.float32)
+        m = vma_full(q, (bh, tq, 1), jnp.float32, _NEG_INF)
+        l = vma_full(q, (bh, tq, 1), jnp.float32)
         for j in range(num_k):
             r0 = j * block_k
             kb, vb = k[:, r0:r0 + block_k], v[:, r0:r0 + block_k]
@@ -285,13 +285,12 @@ def _blockwise_fwd_xla(q, k, v, q_start, k_start, *, scale, causal, block_k,
             o = o * alpha + f32("bqk,bkd->bqd", p.astype(v.dtype), vb)
             return o, m_new, l
 
-        # carries derived from q so their varying-manual-axes type matches
-        # the body's outputs under shard_map's vma checking
-        zcol = q.astype(jnp.float32).sum(-1, keepdims=True) * 0.0
         o, m, l = lax.fori_loop(
             0, num_k,
             body,
-            (q.astype(jnp.float32) * 0.0, zcol + _NEG_INF, zcol),
+            (vma_full(q, q.shape, jnp.float32),
+             vma_full(q, (bh, tq, 1), jnp.float32, _NEG_INF),
+             vma_full(q, (bh, tq, 1), jnp.float32)),
         )
 
     out = (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
@@ -366,9 +365,8 @@ def _blockwise_bwd(q, k, v, o, lse, q_start, k_start, g, g_lse,
         dv = lax.dynamic_update_slice_in_dim(dv, dvb, j * block_k, axis=1)
         return dq, dk, dv
 
-    # fp32 carries derived from the operands so device-varying types
-    # (shard_map vma) match between the loop carry input and output
-    init = tuple(x.astype(jnp.float32) * 0.0 for x in (q, k, v))
+    # fp32 carries vma-typed like the operands
+    init = tuple(vma_full(x, x.shape, jnp.float32) for x in (q, k, v))
     dq, dk, dv = lax.fori_loop(0, num_k, body, init)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 

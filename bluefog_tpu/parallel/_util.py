@@ -2,7 +2,32 @@
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
 from jax import lax
+
+
+def vma_full(ref, shape, dtype, fill=0.0):
+    """A constant array carrying ``ref``'s varying-manual-axes type.
+
+    The safe way to build sentinels/inits inside ``shard_map``: fresh
+    ``jnp.full`` constants are unvarying-typed and fail vma checks against
+    compute branches, while operand arithmetic (``ref * 0.0``) propagates
+    NaN whenever ``ref`` contains inf.  Outside a trace (or on pre-vma
+    JAX) this is just ``jnp.full``.
+    """
+    z = jnp.full(shape, fill, dtype)
+    try:
+        vma = tuple(jax.typeof(ref).vma)
+    except (AttributeError, TypeError):
+        return z
+    if not vma:
+        return z
+    if hasattr(lax, "pcast"):
+        return lax.pcast(z, vma, to="varying")
+    if hasattr(lax, "pvary"):
+        return lax.pvary(z, vma)
+    return z
 
 
 def pvary(x, axis_name):

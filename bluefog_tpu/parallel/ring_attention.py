@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from bluefog_tpu.parallel._util import resolve_axis_size
+from bluefog_tpu.parallel._util import resolve_axis_size, vma_full
 
 __all__ = [
     "ring_attention",
@@ -90,13 +90,12 @@ def ring_attention(
         return m_new, l, o
 
     all_valid = jnp.ones((1, 1, Tq, Tk), bool)
+    tri = (jnp.arange(Tk)[None, :] <= jnp.arange(Tq)[:, None])[None, None]
     kv = (k.astype(jnp.float32), v.astype(jnp.float32))
     for step in range(n):
         kb, vb = kv
         j = (idx - step) % n  # which global block this device holds now
         if causal and Tq == Tk:
-            tri = (jnp.arange(Tk)[None, :]
-                   <= jnp.arange(Tq)[:, None])[None, None]
             m, l, o = _causal_hop_dispatch(
                 step, idx,
                 lambda ops: fold_block(*ops, tri),
@@ -161,12 +160,11 @@ def ring_flash_attention(
         )
 
     def masked_hop(ops):
-        # sentinels derived from the operands so their varying-manual-axes
-        # type matches the compute branches under shard_map's vma checking
+        # sentinels vma-typed like the compute branches' outputs
         q_, _, _ = ops
-        zero = q_.astype(jnp.float32) * 0.0
-        return (zero.astype(q_.dtype),
-                zero.sum(-1).transpose(0, 2, 1) - 1e30)
+        b, t, h, _ = q_.shape
+        return (vma_full(q_, q_.shape, q_.dtype),
+                vma_full(q_, (b, h, t), jnp.float32, -1e30))
 
     def diag_hop(ops):
         # q_start == k_start: relative masking suffices, and static zero

@@ -1,0 +1,39 @@
+"""Subprocess smoke tests for the composition examples (tp/pp/moe gossip):
+each must run a few steps on the 8-device CPU mesh and report a finite,
+decreasing-ish loss.  The reference treats its examples as end-to-end
+smoke tests the same way (SURVEY.md §4)."""
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CASES = [
+    ("examples/jax_tp_gossip.py", ["--steps", "4", "--dp", "4", "--tp", "2"]),
+    ("examples/jax_pp_gossip.py", ["--steps", "4", "--dp", "2", "--pp", "4"]),
+    ("examples/jax_moe_gossip.py", ["--steps", "4", "--dp", "2", "--ep", "4"]),
+]
+
+
+@pytest.mark.parametrize("script,args", CASES, ids=[c[0] for c in CASES])
+def test_example_runs_and_loss_finite(script, args):
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        # drop the axon sitecustomize so the env vars take effect
+        PYTHONPATH=REPO,
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, script)] + args,
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    losses = [float(m) for m in re.findall(r"loss (\d+\.\d+)", proc.stdout)]
+    assert losses, proc.stdout
+    assert all(l == l and l < 100 for l in losses)  # finite, sane
+    assert "done:" in proc.stdout

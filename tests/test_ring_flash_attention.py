@@ -29,17 +29,19 @@ def _qkv(rng, B=2, T=32, H=2, D=8):
     return mk(ks[0]), mk(ks[1]), mk(ks[2])
 
 
-def _ring_fn(mesh, causal):
+def _ring_fn(mesh, causal, *, interpret=True, impl="auto", check_vma=None):
+    if check_vma is None:
+        check_vma = not interpret  # pallas interpret mode is not vma-aware
     return jax.jit(
         jax.shard_map(
             lambda q, k, v: ring_flash_attention(
                 q, k, v, NODES_AXIS, SIZE, causal=causal,
-                block_q=4, block_k=4, interpret=True,
+                block_q=4, block_k=4, interpret=interpret, impl=impl,
             ),
             mesh=mesh,
             in_specs=P(None, NODES_AXIS),
             out_specs=P(None, NODES_AXIS),
-            check_vma=False,  # pallas interpret mode is not vma-aware
+            check_vma=check_vma,
         )
     )
 
@@ -53,18 +55,7 @@ def test_ring_flash_xla_impl_under_default_vma(causal, devices):
 
     mesh = basics.context().mesh
     q, k, v = _qkv(jax.random.PRNGKey(5))
-    out = jax.jit(
-        jax.shard_map(
-            lambda q, k, v: ring_flash_attention(
-                q, k, v, NODES_AXIS, SIZE, causal=causal,
-                block_q=4, block_k=4, interpret=False, impl="xla",
-            ),
-            mesh=mesh,
-            in_specs=P(None, NODES_AXIS),
-            out_specs=P(None, NODES_AXIS),
-            # default check_vma (True)
-        )
-    )(q, k, v)
+    out = _ring_fn(mesh, causal, interpret=False, impl="xla")(q, k, v)
     ref = dense_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
