@@ -69,6 +69,7 @@ from bluefog_tpu.windows import (
     win_accumulate,
     win_accumulate_nonblocking,
     win_update,
+    win_put_update,
     win_update_then_collect,
     win_wait,
     win_poll,

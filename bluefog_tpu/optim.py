@@ -444,8 +444,9 @@ class DistributedWinPutOptimizer:
                             functools.partial(_unpack_leaves, shapes=shapes)
                         ),
                     )
-                    windows.win_put(pack([flat[i] for i in idxs]), name)
-                    parts = unpack(windows.win_update(name))
+                    parts = unpack(
+                        windows.win_put_update(pack([flat[i] for i in idxs]), name)
+                    )
                     for i, part in zip(idxs, parts):
                         flat[i] = part
             else:

@@ -59,6 +59,7 @@ __all__ = [
     "win_accumulate",
     "win_accumulate_nonblocking",
     "win_update",
+    "win_put_update",
     "win_update_then_collect",
     "win_wait",
     "win_poll",
@@ -99,6 +100,8 @@ class _Window:
             if zero_init
             else jnp.ones((ctx.size, maxd), dtype=jnp.float32)
         )
+        # device-resident host constants for the default-weights fused path
+        self.default_consts = None
 
 
 def _ctx():
@@ -151,59 +154,101 @@ def _class_scales(
     return scales, active
 
 
+def _exchange_body(plan, accumulate, with_p, x, mail0, ver0, p_self, pm0,
+                   scales, active, idx):
+    """Per-rank exchange: deposit (scaled) payloads into destination
+    mailbox slots — the ppermute lowering of MPI_Put/MPI_Accumulate [U].
+    Local shapes: x [1,...], mail0 [maxd,...], ver0 [maxd], p_self [1],
+    pm0 [maxd], scales/active [C,1] (sharded by rank)."""
+    for c, cls in enumerate(plan.classes):
+        wdt = x.dtype if jnp.issubdtype(x.dtype, jnp.inexact) else jnp.float32
+        scale = scales[c, 0].astype(wdt)
+        payload = (x[0].astype(wdt) * scale).astype(x.dtype)
+        recvd = lax.ppermute(payload, NODES_AXIS, cls.perm)
+        slot = jnp.asarray(cls.slot_index)[idx]
+        valid = jnp.asarray(cls.recv_mask)[idx].astype(bool) & (active[c, 0] > 0)
+        slot_c = jnp.maximum(slot, 0)
+        cur = lax.dynamic_index_in_dim(mail0, slot_c, axis=0, keepdims=False)
+        new = cur + recvd if accumulate else recvd
+        mail0 = jnp.where(
+            valid, lax.dynamic_update_index_in_dim(mail0, new, slot_c, axis=0), mail0
+        )
+        ver0 = jnp.where(
+            valid,
+            lax.dynamic_update_index_in_dim(
+                ver0, lax.dynamic_index_in_dim(ver0, slot_c, 0, keepdims=False) + 1,
+                slot_c, axis=0,
+            ),
+            ver0,
+        )
+        if with_p:
+            p_recvd = lax.ppermute(p_self[0] * scales[c, 0], NODES_AXIS, cls.perm)
+            p_cur = lax.dynamic_index_in_dim(pm0, slot_c, 0, keepdims=False)
+            p_new = p_cur + p_recvd if accumulate else p_recvd
+            pm0 = jnp.where(
+                valid,
+                lax.dynamic_update_index_in_dim(pm0, p_new, slot_c, axis=0),
+                pm0,
+            )
+    return mail0, ver0, pm0
+
+
 def _build_exchange(plan: CommPlan, accumulate: bool, with_p: bool):
-    """Jitted rank-major exchange: deposit (scaled) payloads into destination
-    mailbox slots — the ppermute lowering of MPI_Put/MPI_Accumulate [U]."""
+    """Jitted rank-major exchange (see :func:`_exchange_body`)."""
     ctx = _ctx()
-    maxd = max(plan.max_in_degree, 1)
 
     def spmd(x, mail, versions, p_self, p_mail, scales, active):
-        # local shapes: x [1,...], mail [1,maxd,...], versions [1,maxd],
-        # p_self [1], p_mail [1,maxd], scales/active [C,1] (sharded by rank)
         idx = lax.axis_index(NODES_AXIS)
-        mail0 = mail[0]
-        ver0 = versions[0]
-        pm0 = p_mail[0]
-        for c, cls in enumerate(plan.classes):
-            wdt = x.dtype if jnp.issubdtype(x.dtype, jnp.inexact) else jnp.float32
-            scale = scales[c, 0].astype(wdt)
-            payload = (x[0].astype(wdt) * scale).astype(x.dtype)
-            recvd = lax.ppermute(payload, NODES_AXIS, cls.perm)
-            slot = jnp.asarray(cls.slot_index)[idx]
-            valid = jnp.asarray(cls.recv_mask)[idx].astype(bool) & (active[c, 0] > 0)
-            slot_c = jnp.maximum(slot, 0)
-            cur = lax.dynamic_index_in_dim(mail0, slot_c, axis=0, keepdims=False)
-            new = cur + recvd if accumulate else recvd
-            mail0 = jnp.where(
-                valid, lax.dynamic_update_index_in_dim(mail0, new, slot_c, axis=0), mail0
-            )
-            ver0 = jnp.where(
-                valid,
-                lax.dynamic_update_index_in_dim(
-                    ver0, lax.dynamic_index_in_dim(ver0, slot_c, 0, keepdims=False) + 1,
-                    slot_c, axis=0,
-                ),
-                ver0,
-            )
-            if with_p:
-                p_recvd = lax.ppermute(p_self[0] * scales[c, 0], NODES_AXIS, cls.perm)
-                p_cur = lax.dynamic_index_in_dim(pm0, slot_c, 0, keepdims=False)
-                p_new = p_cur + p_recvd if accumulate else p_recvd
-                pm0 = jnp.where(
-                    valid,
-                    lax.dynamic_update_index_in_dim(pm0, p_new, slot_c, axis=0),
-                    pm0,
-                )
+        mail0, ver0, pm0 = _exchange_body(
+            plan, accumulate, with_p, x, mail[0], versions[0], p_self,
+            p_mail[0], scales, active, idx,
+        )
         return mail0[None], ver0[None], pm0[None]
 
-    mesh = ctx.mesh
     return jax.jit(
         jax.shard_map(
             spmd,
-            mesh=mesh,
+            mesh=ctx.mesh,
             in_specs=(P(NODES_AXIS), P(NODES_AXIS), P(NODES_AXIS), P(NODES_AXIS),
                       P(NODES_AXIS), P(None, NODES_AXIS), P(None, NODES_AXIS)),
             out_specs=(P(NODES_AXIS), P(NODES_AXIS), P(NODES_AXIS)),
+        )
+    )
+
+
+def _build_put_update(plan: CommPlan, accumulate: bool, with_p: bool, wdt):
+    """One compiled program for put/accumulate + local weighted combine —
+    the fused hot path of :func:`win_put_update` (one dispatch instead of
+    an exchange jit plus a combine jit; XLA schedules the ppermute rounds
+    together with the FMA combine)."""
+    ctx = _ctx()
+
+    def spmd(x, mail, versions, p_self, p_mail, scales, active, wmat, swvec):
+        idx = lax.axis_index(NODES_AXIS)
+        mail0, ver0, pm0 = _exchange_body(
+            plan, accumulate, with_p, x, mail[0], versions[0], p_self,
+            p_mail[0], scales, active, idx,
+        )
+        extra = (1,) * (x.ndim - 1)  # x local [1, ...]: payload rank is ndim-1
+        w = wmat[0].astype(wdt).reshape(wmat.shape[1:2] + extra)
+        sw = swvec[0].astype(wdt)
+        combined = sw * x[0].astype(wdt) + (w * mail0.astype(wdt)).sum(axis=0)
+        if with_p:
+            p_new = swvec[0] * p_self[0] + (wmat[0] * pm0).sum()
+        else:
+            p_new = p_self[0]
+        return (combined.astype(x.dtype)[None], mail0[None], ver0[None],
+                pm0[None], p_new[None])
+
+    return jax.jit(
+        jax.shard_map(
+            spmd,
+            mesh=ctx.mesh,
+            in_specs=(P(NODES_AXIS), P(NODES_AXIS), P(NODES_AXIS), P(NODES_AXIS),
+                      P(NODES_AXIS), P(None, NODES_AXIS), P(None, NODES_AXIS),
+                      P(NODES_AXIS), P(NODES_AXIS)),
+            out_specs=(P(NODES_AXIS), P(NODES_AXIS), P(NODES_AXIS),
+                       P(NODES_AXIS), P(NODES_AXIS)),
         )
     )
 
@@ -326,6 +371,41 @@ def win_get_nonblocking(name: str, src_weights: WeightsArg = None):
     return Handle(_win(name).mail)
 
 
+def _reset_mailbox(win: _Window) -> None:
+    win.mail = jnp.zeros_like(win.mail)
+    win.p_mail = jnp.zeros_like(win.p_mail)
+
+
+def _update_weights(win: _Window, self_weight, neighbor_weights):
+    """Host-side combine weights: matrix [size, maxd] + self vector [size]
+    (the reference ``win_update`` weight convention: default uniform
+    1/(in_degree+1); explicit neighbor weights imply self = 1 - sum)."""
+    plan = win.plan
+    size = plan.size
+    maxd = max(plan.max_in_degree, 1)
+    wmat = np.zeros((size, maxd), dtype=np.float32)
+    swvec = np.zeros((size,), dtype=np.float32)
+    for d in range(size):
+        nbrs = plan.in_neighbors[d]
+        if neighbor_weights is not None:
+            for k, s in enumerate(nbrs):
+                wmat[d, k] = float(neighbor_weights[d].get(s, 0.0))
+        else:
+            for k in range(len(nbrs)):
+                wmat[d, k] = 1.0 / (len(nbrs) + 1)
+        if self_weight is None:
+            swvec[d] = (
+                1.0 - wmat[d].sum()
+                if neighbor_weights is not None
+                else 1.0 / (len(nbrs) + 1)
+            )
+        elif np.isscalar(self_weight):
+            swvec[d] = float(self_weight)
+        else:
+            swvec[d] = float(self_weight[d])
+    return wmat, swvec
+
+
 def _combine(self_tensor, mail, p_self, p_mail, wmat, swvec, *, wdt, with_p):
     """Fused local weighted combine (jitted via the context cache)."""
     size, maxd = wmat.shape
@@ -353,31 +433,8 @@ def win_update(
     with timeline_context("win_update"):
         ctx = _ctx()
         win = _win(name)
-        plan = win.plan
-        size = ctx.size
-        maxd = max(plan.max_in_degree, 1)
-        # weight matrix [size, maxd] + self vector [size]
-        wmat = np.zeros((size, maxd), dtype=np.float32)
-        swvec = np.zeros((size,), dtype=np.float32)
-        for d in range(size):
-            nbrs = plan.in_neighbors[d]
-            if neighbor_weights is not None:
-                for k, s in enumerate(nbrs):
-                    wmat[d, k] = float(neighbor_weights[d].get(s, 0.0))
-            else:
-                for k in range(len(nbrs)):
-                    wmat[d, k] = 1.0 / (len(nbrs) + 1)
-            if self_weight is None:
-                swvec[d] = (
-                    1.0 - wmat[d].sum()
-                    if neighbor_weights is not None
-                    else 1.0 / (len(nbrs) + 1)
-                )
-            elif np.isscalar(self_weight):
-                swvec[d] = float(self_weight)
-            else:
-                swvec[d] = float(self_weight[d])
-
+        maxd = max(win.plan.max_in_degree, 1)
+        wmat, swvec = _update_weights(win, self_weight, neighbor_weights)
         wdt = win.dtype if jnp.issubdtype(win.dtype, jnp.inexact) else jnp.float32
         with_p = ctx.win_associated_p_enabled
         # one fused kernel per (shape, dtype, with_p); weights are traced
@@ -400,10 +457,68 @@ def win_update(
         if with_p:
             win.p_self = p_self
         if reset:
-            win.mail = jnp.zeros_like(win.mail)
-            win.p_mail = jnp.zeros_like(win.p_mail)
+            _reset_mailbox(win)
         out = win.self_tensor
         return jnp.array(out) if clone else out
+
+
+def win_put_update(
+    tensor,
+    name: str,
+    dst_weights: WeightsArg = None,
+    *,
+    self_weight: Optional[Union[float, Sequence[float]]] = None,
+    neighbor_weights: WeightsArg = None,
+    accumulate: bool = False,
+    reset: bool = False,
+):
+    """Fused ``win_put`` (or ``win_accumulate``) + ``win_update`` in ONE
+    compiled program — the hot path of :class:`DistributedWinPutOptimizer`
+    and the gossip benchmark.  Semantically identical to the two calls in
+    sequence; returns the combined tensor.  Not a reference API (upstream's
+    put and update run on different sides of an RMA epoch); provided
+    because under the mailbox emulation the pair always executes back to
+    back, and one dispatch lets XLA schedule the exchange with the combine.
+    """
+    with timeline_context("win_put_update"):
+        ctx = _ctx()
+        win = _win(name)
+        t = jnp.asarray(tensor, dtype=win.dtype)
+        if dst_weights is None and self_weight is None and neighbor_weights is None:
+            # the optimizer hot path: the four weight arrays are constant
+            # per window, so build + upload them once
+            if win.default_consts is None:
+                scales, active = _class_scales(win.plan, None, side="send")
+                wmat, swvec = _update_weights(win, None, None)
+                win.default_consts = tuple(
+                    jnp.asarray(a) for a in (scales, active, wmat, swvec)
+                )
+            scales_d, active_d, wmat_d, swvec_d = win.default_consts
+        else:
+            scales, active = _class_scales(win.plan, dst_weights, side="send")
+            wmat, swvec = _update_weights(win, self_weight, neighbor_weights)
+            scales_d, active_d, wmat_d, swvec_d = (
+                jnp.asarray(scales), jnp.asarray(active),
+                jnp.asarray(wmat), jnp.asarray(swvec),
+            )
+        with_p = ctx.win_associated_p_enabled
+        wdt = win.dtype if jnp.issubdtype(win.dtype, jnp.inexact) else jnp.float32
+        key = ("win_put_update", win.plan, accumulate, with_p, win.dtype,
+               win.shape[1:])
+        f = ctx.jit_cache(
+            key, lambda: _build_put_update(win.plan, accumulate, with_p, wdt)
+        )
+        combined, mail, versions, p_mail, p_self = f(
+            t, win.mail, win.versions, win.p_self, win.p_mail,
+            scales_d, active_d, wmat_d, swvec_d,
+        )
+        win.self_tensor = combined
+        win.mail, win.versions = mail, versions
+        if with_p:
+            win.p_mail, win.p_self = p_mail, p_self
+        if reset:
+            _reset_mailbox(win)
+        return combined
 
 
 def win_update_then_collect(name: str, require_mutex: bool = False):

@@ -161,6 +161,35 @@ def test_push_sum_with_associated_p():
     np.testing.assert_allclose(debiased, np.tile(mean0, (SIZE, 1)), atol=1e-2)
 
 
+@pytest.mark.parametrize("accumulate", [False, True])
+def test_win_put_update_fused_matches_sequential(accumulate):
+    """The fused single-dispatch win_put_update equals put/accumulate
+    followed by update, including weights, versions, and associated p."""
+    bf.turn_on_win_ops_with_associated_p()
+    bf.set_topology(tu.ExponentialTwoGraph(SIZE))
+    x = rank_tensor((3,))
+    dst = [{d: 0.5 for d in tu.GetSendWeights(tu.ExponentialTwoGraph(SIZE), r)[1]}
+           for r in range(SIZE)]
+    sw = 0.25
+
+    bf.win_create(x, "seq", zero_init=True)
+    if accumulate:
+        bf.win_accumulate(x, "seq", dst_weights=dst)
+    else:
+        bf.win_put(x, "seq", dst_weights=dst)
+    expected = bf.win_update("seq", self_weight=sw)
+    ver_seq = bf.get_win_version("seq")
+    p_seq = np.asarray(bf.win_associated_p("seq"))
+
+    bf.win_create(x, "fused", zero_init=True)
+    got = bf.win_put_update(x, "fused", dst_weights=dst,
+                            self_weight=sw, accumulate=accumulate)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=1e-6)
+    assert bf.get_win_version("fused") == ver_seq
+    np.testing.assert_allclose(np.asarray(bf.win_associated_p("fused")),
+                               p_seq, rtol=1e-6)
+
+
 def test_win_set_exposed_debias_restart():
     """win_set_exposed stores a new exposed tensor + resets p — the push-sum
     debias-and-restart idiom without touching window internals."""
