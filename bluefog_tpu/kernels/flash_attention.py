@@ -59,15 +59,18 @@ _LANES = 128
 _MAX_UNROLL = 64  # triangular fast paths unroll at most this many k blocks
 
 
-def _use_triangular(causal, aligned, tq, tk, num_k):
-    """Shared gate for the fwd/bwd triangular fast paths (zero offsets,
-    square shapes, bounded unroll)."""
-    return causal and aligned and tq == tk and num_k <= _MAX_UNROLL
+def _use_triangular(causal, tri_delta, tq, tk, num_k):
+    """Shared gate for the fwd/bwd triangular fast paths: static offsets
+    with a small non-negative key-ahead delta (0 = aligned; 1 = the striped
+    ring's strict-lower-triangle hops), square shapes, bounded unroll."""
+    return (causal and tri_delta is not None and tq == tk
+            and num_k <= _MAX_UNROLL)
 
 
-def _tri_mask(rows, block_k):
-    """Causal mask for a q-row slice starting exactly at the k block."""
-    return jnp.arange(rows)[:, None] >= jnp.arange(block_k)[None, :]
+def _tri_mask(rows, block_k, delta=0):
+    """Causal mask for a q-row slice starting exactly at the k block, with
+    keys shifted ``delta`` positions ahead (visible iff col + delta <= row)."""
+    return jnp.arange(rows)[:, None] >= jnp.arange(block_k)[None, :] + delta
 
 
 def _default_interpret() -> bool:
@@ -229,7 +232,7 @@ def _flash_fwd(q, k, v, q_start, k_start, *, scale, causal, block_q, block_k,
 
 
 def _blockwise_fwd_xla(q, k, v, q_start, k_start, *, scale, causal, block_k,
-                       aligned):
+                       tri_delta):
     """Online-softmax blockwise forward in plain XLA; same math and
     (o, lse) contract as the Pallas kernel.
 
@@ -245,7 +248,7 @@ def _blockwise_fwd_xla(q, k, v, q_start, k_start, *, scale, causal, block_k,
     num_k = tk // block_k
     f32 = functools.partial(jnp.einsum, preferred_element_type=jnp.float32)
 
-    if _use_triangular(causal, aligned, tq, tk, num_k):
+    if _use_triangular(causal, tri_delta, tq, tk, num_k):
         # triangular unroll: k block j touches only q rows >= j*block_k
         o = vma_full(q, q.shape, jnp.float32)
         m = vma_full(q, (bh, tq, 1), jnp.float32, _NEG_INF)
@@ -254,10 +257,15 @@ def _blockwise_fwd_xla(q, k, v, q_start, k_start, *, scale, causal, block_k,
             r0 = j * block_k
             kb, vb = k[:, r0:r0 + block_k], v[:, r0:r0 + block_k]
             s = f32("bqd,bkd->bqk", q[:, r0:], kb) * scale
-            s = jnp.where(_tri_mask(tq - r0, block_k)[None], s, _NEG_INF)
+            s = jnp.where(_tri_mask(tq - r0, block_k, tri_delta)[None], s,
+                          _NEG_INF)
             m_new = jnp.maximum(m[:, r0:], s.max(-1, keepdims=True))
             alpha = jnp.exp(m[:, r0:] - m_new)
-            p = jnp.exp(s - m_new)  # masked entries underflow to 0
+            p = jnp.exp(s - m_new)  # masked entries underflow to 0...
+            if tri_delta:
+                # ...except on fully-masked rows (rows < delta), where
+                # m_new is the sentinel and exp(0) would be 1
+                p = jnp.where(s > _MASK_THRESH, p, 0.0)
             l = l.at[:, r0:].set(l[:, r0:] * alpha + p.sum(-1, keepdims=True))
             o = o.at[:, r0:].set(
                 o[:, r0:] * alpha + f32("bqk,bkd->bqd", p.astype(v.dtype), vb)
@@ -299,14 +307,14 @@ def _blockwise_fwd_xla(q, k, v, q_start, k_start, *, scale, causal, block_k,
 
 
 def _blockwise_bwd(q, k, v, o, lse, q_start, k_start, g, g_lse,
-                   *, scale, causal, block_k, aligned=False):
+                   *, scale, causal, block_k, tri_delta=None):
     """dQ/dK/dV via per-k-block recompute from lse; all [BH, T, D].
 
     ``g_lse`` is the lse output's cotangent: d lse/d s is the normalized
     probability row, so it folds into dS as ``p * g_lse`` (used by ring
-    attention's merge; zeros for plain attention).  ``aligned`` (static)
-    asserts q_start == k_start == 0 with tq == tk, enabling the triangular
-    fast path.
+    attention's merge; zeros for plain attention).  ``tri_delta`` (static
+    int or None) asserts static offsets with key-ahead delta and tq == tk,
+    enabling the triangular fast path.
     """
     bh, tq, d = q.shape
     tk = k.shape[1]
@@ -320,7 +328,7 @@ def _blockwise_bwd(q, k, v, o, lse, q_start, k_start, g, g_lse,
                     axis=-1, keepdims=True)  # [BH, Tq, 1]
     corr = g_lse.astype(jnp.float32)[..., None] - delta  # [BH, Tq, 1]
 
-    if _use_triangular(causal, aligned, tq, tk, num_k):
+    if _use_triangular(causal, tri_delta, tq, tk, num_k):
         # Triangular fast path: with zero offsets, k block j only reaches q
         # rows >= j*block_k — static slicing halves the causal bwd FLOPs
         # that the dynamic fori_loop below must spend on fully-masked rows.
@@ -331,8 +339,12 @@ def _blockwise_bwd(q, k, v, o, lse, q_start, k_start, g, g_lse,
             kb, vb = k[:, r0:r0 + block_k], v[:, r0:r0 + block_k]
             qj, gj = q[:, r0:], g[:, r0:]
             s = f32("bqd,bkd->bqk", qj, kb) * scale
-            s = jnp.where(_tri_mask(tq - r0, block_k)[None], s, _NEG_INF)
+            s = jnp.where(_tri_mask(tq - r0, block_k, tri_delta)[None], s,
+                          _NEG_INF)
             p = jnp.exp(s - lse[:, r0:, None])  # masked entries underflow to 0
+            if tri_delta:
+                # fully-masked rows have sentinel lse: exp would explode
+                p = jnp.where(s > _MASK_THRESH, p, 0.0)
             dvs.append(f32("bqk,bqd->bkd", p.astype(gj.dtype), gj))
             dp = f32("bqd,bkd->bqk", gj, vb)
             ds = (p * (dp + corr[:, r0:]) * scale).astype(q.dtype)
@@ -372,7 +384,7 @@ def _blockwise_bwd(q, k, v, o, lse, q_start, k_start, g, g_lse,
 
 
 def _fwd_dispatch(q, k, v, q_start, k_start, *, scale, causal, block_q,
-                  block_k, interpret, aligned, impl):
+                  block_k, interpret, tri_delta, impl):
     """Choose the forward implementation (static): "pallas", "xla", or
     "auto" (= XLA blockwise when compiling, Pallas in interpret mode so the
     kernel logic keeps CPU test coverage)."""
@@ -380,7 +392,7 @@ def _fwd_dispatch(q, k, v, q_start, k_start, *, scale, causal, block_q,
     if use_xla:
         return _blockwise_fwd_xla(
             q, k, v, q_start, k_start,
-            scale=scale, causal=causal, block_k=block_k, aligned=aligned,
+            scale=scale, causal=causal, block_k=block_k, tri_delta=tri_delta,
         )
     return _flash_fwd(
         q, k, v, q_start, k_start,
@@ -391,33 +403,33 @@ def _fwd_dispatch(q, k, v, q_start, k_start, *, scale, causal, block_q,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
 def _flash_core(q, k, v, q_start, k_start, scale, causal, block_q, block_k,
-                interpret, aligned, impl):
+                interpret, tri_delta, impl):
     """(o, lse) with offsets as float32 scalars (zero-cotangent slots)."""
     return _fwd_dispatch(
         q, k, v, q_start.astype(jnp.int32), k_start.astype(jnp.int32),
         scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-        interpret=interpret, aligned=aligned, impl=impl,
+        interpret=interpret, tri_delta=tri_delta, impl=impl,
     )
 
 
 def _flash_core_fwd(q, k, v, q_start, k_start, scale, causal, block_q,
-                    block_k, interpret, aligned, impl):
+                    block_k, interpret, tri_delta, impl):
     o, lse = _fwd_dispatch(
         q, k, v, q_start.astype(jnp.int32), k_start.astype(jnp.int32),
         scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-        interpret=interpret, aligned=aligned, impl=impl,
+        interpret=interpret, tri_delta=tri_delta, impl=impl,
     )
     return (o, lse), (q, k, v, o, lse, q_start, k_start)
 
 
-def _flash_core_bwd(scale, causal, block_q, block_k, interpret, aligned, impl,
-                    res, cts):
+def _flash_core_bwd(scale, causal, block_q, block_k, interpret, tri_delta,
+                    impl, res, cts):
     q, k, v, o, lse, q_start, k_start = res
     g, g_lse = cts
     dq, dk, dv = _blockwise_bwd(
         q, k, v, o, lse,
         q_start.astype(jnp.int32), k_start.astype(jnp.int32), g, g_lse,
-        scale=scale, causal=causal, block_k=block_k, aligned=aligned,
+        scale=scale, causal=causal, block_k=block_k, tri_delta=tri_delta,
     )
     return dq, dk, dv, jnp.zeros_like(q_start), jnp.zeros_like(k_start)
 
@@ -459,16 +471,17 @@ def flash_attention_with_lse(
     def fold(x):  # [B, T, H, D] -> [B*H, T, D]
         return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
 
-    # static zero offsets + square shapes unlock the triangular backward
-    aligned = (
-        isinstance(q_start, int) and q_start == 0
-        and isinstance(k_start, int) and k_start == 0
-        and q.shape[1] == k.shape[1]
-    )
+    # static offsets with a small key-ahead delta + square shapes unlock
+    # the triangular fast paths (delta 0 = aligned; delta 1 = the striped
+    # ring's strict-lower-triangle hops)
+    tri_delta = None
+    if (isinstance(q_start, int) and isinstance(k_start, int)
+            and 0 <= k_start - q_start <= 8 and q.shape[1] == k.shape[1]):
+        tri_delta = k_start - q_start
     o, lse = _flash_core(
         fold(q), fold(k), fold(v),
         jnp.asarray(q_start, jnp.float32), jnp.asarray(k_start, jnp.float32),
-        scale, causal, block_q, block_k, interpret, aligned, impl,
+        scale, causal, block_q, block_k, interpret, tri_delta, impl,
     )
     o = o.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
     return o, lse.reshape(b, h, tq)

@@ -31,7 +31,49 @@ __all__ = [
     "ring_attention",
     "ring_flash_attention",
     "make_ring_attention_fn",
+    "stripe_blocks",
+    "unstripe_blocks",
+    "striped_positions",
 ]
+
+
+def stripe_blocks(x, n: int, axis: int = 1):
+    """Permute a global sequence so contiguous shard ``r`` of the result
+    holds global positions ``r, r+n, r+2n, ...`` — the *striped* layout.
+
+    Striping balances causal ring attention: with contiguous blocks, hop
+    ``s`` is fully masked on devices ``idx < s`` but SPMD lock-step still
+    waits for the devices computing full hops, so block-level skipping
+    saves no wall-clock; striped, every hop is a near-triangular half-load
+    on every device (~2x wall-clock for long causal sequences; same idea
+    as striped attention, arXiv:2311.09431).  Apply before sharding; undo
+    with :func:`unstripe_blocks`.
+    """
+    t = x.shape[axis]
+    if t % n:
+        raise ValueError(f"sequence length {t} not divisible by {n}")
+    x = jnp.moveaxis(x, axis, 0)
+    x = x.reshape((t // n, n) + x.shape[1:])  # [L, n, ...]: in[i*n + r]
+    x = jnp.swapaxes(x, 0, 1).reshape((t,) + x.shape[2:])  # out[r*L + i]
+    return jnp.moveaxis(x, 0, axis)
+
+
+def unstripe_blocks(x, n: int, axis: int = 1):
+    """Inverse of :func:`stripe_blocks`."""
+    t = x.shape[axis]
+    if t % n:
+        raise ValueError(f"sequence length {t} not divisible by {n}")
+    x = jnp.moveaxis(x, axis, 0)
+    x = x.reshape((n, t // n) + x.shape[1:])  # [n, L, ...]: in[r*L + i]
+    x = jnp.swapaxes(x, 0, 1).reshape((t,) + x.shape[2:])  # out[i*n + r]
+    return jnp.moveaxis(x, 0, axis)
+
+
+def striped_positions(t_local: int, axis_name: str):
+    """Global positions of this device's striped shard (``i*n + idx``) —
+    feed to rotary/positional encodings when training striped."""
+    n = lax.axis_size(axis_name)
+    return jnp.arange(t_local) * n + lax.axis_index(axis_name)
 
 
 def _causal_hop_dispatch(step, idx, diag_fn, visible_fn, masked_fn, ops):
@@ -54,10 +96,13 @@ def ring_attention(
     axis_size: int,
     *,
     causal: bool = True,
+    striped: bool = False,
 ) -> jnp.ndarray:
     """Exact blockwise attention across sequence shards on ``axis_name``.
 
-    q, k, v: [B, T_local, H, D] (this device's sequence block).
+    q, k, v: [B, T_local, H, D] (this device's sequence block; the
+    :func:`stripe_blocks` layout when ``striped=True`` — see its docstring
+    for why striping balances the causal load).
     Returns [B, T_local, H, D] in q's dtype.
     """
     n = resolve_axis_size(axis_name, axis_size)
@@ -66,6 +111,12 @@ def ring_attention(
     scale = 1.0 / math.sqrt(D)
     idx = lax.axis_index(axis_name)
 
+    if striped and causal and Tq != Tk:
+        raise ValueError(
+            f"striped causal ring attention needs equal q/k shard lengths "
+            f"(got {Tq} vs {Tk}); the striped layout has no contiguous-"
+            f"block fallback"
+        )
     qf = q.astype(jnp.float32)
     m = jnp.full((B, H, Tq), -jnp.inf, jnp.float32)
     l = jnp.zeros((B, H, Tq), jnp.float32)
@@ -91,11 +142,19 @@ def ring_attention(
 
     all_valid = jnp.ones((1, 1, Tq, Tk), bool)
     tri = (jnp.arange(Tk)[None, :] <= jnp.arange(Tq)[:, None])[None, None]
+    tri_strict = (jnp.arange(Tk)[None, :] < jnp.arange(Tq)[:, None])[None, None]
     kv = (k.astype(jnp.float32), v.astype(jnp.float32))
     for step in range(n):
         kb, vb = kv
         j = (idx - step) % n  # which global block this device holds now
-        if causal and Tq == Tk:
+        if striped and causal and Tq == Tk:
+            # striped layout: key stripe j visible up to/including the
+            # diagonal iff j <= our stripe index (see stripe_blocks); a
+            # mask select beats lax.cond here — both "branches" would run
+            # the identical fold, differing only in a constant mask
+            valid = tri if step == 0 else jnp.where(j <= idx, tri, tri_strict)
+            m, l, o = fold_block(m, l, o, kb, vb, valid)
+        elif causal and Tq == Tk:
             m, l, o = _causal_hop_dispatch(
                 step, idx,
                 lambda ops: fold_block(*ops, tri),
@@ -126,12 +185,19 @@ def ring_flash_attention(
     axis_size: int,
     *,
     causal: bool = True,
+    striped: bool = False,
     block_q: int = 256,
     block_k: int = 256,
     interpret: bool = None,
     impl: str = "auto",
 ) -> jnp.ndarray:
     """Ring attention with blockwise flash attention as the per-hop compute.
+
+    ``striped=True`` assumes the :func:`stripe_blocks` layout (shard ``r``
+    holds global positions ``i*n + r``): every causal hop then reduces to a
+    (near-)triangular mask with static offsets — delta 0 when the key
+    shard's stripe index is <= ours, else delta 1 — so the work is balanced
+    across devices instead of diagonal-heavy (see :func:`stripe_blocks`).
 
     Same semantics/layout as :func:`ring_attention`, but each hop runs
     :func:`bluefog_tpu.kernels.flash_attention_with_lse` — MXU-blocked,
@@ -150,6 +216,12 @@ def ring_flash_attention(
 
     n = resolve_axis_size(axis_name, axis_size)
     tq, tk = q.shape[1], k.shape[1]
+    if striped and causal and tq != tk:
+        raise ValueError(
+            f"striped causal ring attention needs equal q/k shard lengths "
+            f"(got {tq} vs {tk}); the striped layout has no contiguous-"
+            f"block fallback"
+        )
     idx = lax.axis_index(axis_name)
     perm = tuple((i, (i + 1) % n) for i in range(n))
 
@@ -174,13 +246,26 @@ def ring_flash_attention(
     def visible_hop(ops):
         return flash(*ops, q_start=0, k_start=0, causal_=False)
 
+    stripe0_hop = diag_hop  # key stripe index <= ours: tril including diag
+
+    def stripe1_hop(ops):  # key stripe index > ours: strict lower triangle
+        return flash(*ops, q_start=0, k_start=1, causal_=True)
+
     o = None
     lse = None
     kv = (k, v)
     for step in range(n):
         kb, vb = kv
         j = (idx - step) % n  # global index of the key block held this step
-        if causal and tq == tk:
+        if striped and causal and tq == tk:
+            # striped layout: token (i, stripe j) has global pos i*n + j,
+            # so visibility vs our stripe idx depends only on j <= idx
+            o_s, lse_s = (
+                stripe0_hop((q, kb, vb)) if step == 0 else lax.cond(
+                    j <= idx, stripe0_hop, stripe1_hop, (q, kb, vb)
+                )
+            )
+        elif causal and tq == tk:
             o_s, lse_s = _causal_hop_dispatch(
                 step, idx, diag_hop, visible_hop, masked_hop, (q, kb, vb)
             )
@@ -205,15 +290,18 @@ def ring_flash_attention(
 
 
 def make_ring_attention_fn(axis_name: str, axis_size: int, causal: bool = True,
-                           *, flash: bool = False, **flash_kwargs) -> Callable:
+                           *, flash: bool = False, striped: bool = False,
+                           **flash_kwargs) -> Callable:
     """attention_fn for ``models.transformer.LlamaLM``: plugs sequence-
     parallel ring attention into the decoder blocks (``flash=True`` selects
-    the Pallas-kernel hop compute)."""
+    the blockwise flash hop compute; ``striped=True`` the load-balanced
+    :func:`stripe_blocks` layout — pair with :func:`striped_positions`)."""
     if flash:
         return partial(
             ring_flash_attention, axis_name=axis_name, axis_size=axis_size,
-            causal=causal, **flash_kwargs
+            causal=causal, striped=striped, **flash_kwargs
         )
     return partial(
-        ring_attention, axis_name=axis_name, axis_size=axis_size, causal=causal
+        ring_attention, axis_name=axis_name, axis_size=axis_size,
+        causal=causal, striped=striped,
     )
