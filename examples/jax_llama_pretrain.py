@@ -30,7 +30,11 @@ from bluefog_tpu.core import basics
 from bluefog_tpu.core.basics import NODES_AXIS
 from bluefog_tpu.models.transformer import LlamaLM
 from bluefog_tpu.optim import CommunicationType
-from bluefog_tpu.parallel.ring_attention import make_ring_attention_fn
+from bluefog_tpu.parallel.ring_attention import (
+    make_ring_attention_fn,
+    stripe_blocks,
+    striped_positions,
+)
 from bluefog_tpu.training import make_decentralized_train_step, replicate_for_mesh
 
 
@@ -53,12 +57,18 @@ def main():
     parser.add_argument("--vocab", type=int, default=64)
     parser.add_argument("--lr", type=float, default=3e-3)
     parser.add_argument("--seq-parallel", action="store_true")
+    parser.add_argument("--striped", action="store_true",
+                        help="load-balanced striped sequence layout "
+                        "(stripe_blocks; causal hops become uniform "
+                        "half-loads instead of diagonal-heavy)")
     parser.add_argument(
         "--attention", choices=["dense", "flash"], default="dense",
         help="flash = Pallas flash-attention kernel "
         "(ring-flash hops under --seq-parallel)",
     )
     args = parser.parse_args()
+    if args.striped and not args.seq_parallel:
+        parser.error("--striped is a sequence-layout option: add --seq-parallel")
 
     bf.init()
     n = bf.size()
@@ -145,7 +155,9 @@ def run_seq_parallel(args, ctx, n, rng):
     model = LlamaLM(
         vocab_size=args.vocab, hidden_size=args.hidden, num_layers=args.layers,
         num_heads=4, dff=args.hidden * 3, dtype=jnp.float32,
-        attention_fn=make_ring_attention_fn(NODES_AXIS, n, flash=use_flash),
+        attention_fn=make_ring_attention_fn(
+            NODES_AXIS, n, flash=use_flash, striped=args.striped
+        ),
     )
     ids0 = jnp.zeros((1, args.seq_len), jnp.int32)
     dense_twin = LlamaLM(
@@ -159,21 +171,45 @@ def run_seq_parallel(args, ctx, n, rng):
     def spmd_step(params, opt_state, ids):
         # ids: [B, T_local] shard; params replicated
         idx = jax.lax.axis_index(NODES_AXIS)
-        positions = idx * tl + jnp.arange(tl)
+        if args.striped:
+            positions = striped_positions(tl, NODES_AXIS)
+        else:
+            positions = idx * tl + jnp.arange(tl)
 
         def loss_of(p):
             logits = model.apply({"params": p}, ids, positions=positions)
-            # shift within shard; boundary tokens between shards are
-            # dropped from the loss (negligible for tl >> 1)
+            if args.striped:
+                # striped: the successor of local token i (global i*n+idx)
+                # lives at the SAME local index on stripe idx+1 — or local
+                # i+1 on stripe 0 when we are the last stripe.  Only the
+                # one final global token has no target.
+                nxt = jax.lax.ppermute(
+                    ids, NODES_AXIS, [((r + 1) % n, r) for r in range(n)]
+                )
+                shifted = jnp.concatenate(
+                    [nxt[:, 1:], jnp.zeros_like(nxt[:, :1])], axis=1
+                )
+                labels = jnp.where(idx == n - 1, shifted, nxt)
+                mask = jnp.where(idx == n - 1, jnp.arange(tl) < tl - 1,
+                                 jnp.ones((tl,), bool))
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, labels
+                )
+                return (jax.lax.psum((ce * mask).sum(), NODES_AXIS)
+                        / jax.lax.psum(mask.sum() * ce.shape[0], NODES_AXIS))
+            # contiguous: shift within shard; boundary tokens between
+            # shards are dropped from the loss (negligible for tl >> 1)
             return optax.softmax_cross_entropy_with_integer_labels(
                 logits[:, :-1], ids[:, 1:]
             ).mean()
 
         loss, grads = jax.value_and_grad(loss_of)(params)
-        # grads/loss differ across sequence shards -> average globally (the
-        # sequence axis is a compute axis here, not a data axis)
+        # contiguous: per-shard losses are local means -> grads average
+        # (pmean).  striped: the loss is already the psum-normalized global
+        # mean, so each shard's grad is its partial contribution -> SUM.
+        sync = jax.lax.psum if args.striped else jax.lax.pmean
         grads = jax.tree_util.tree_map(
-            lambda g: jax.lax.pmean(g, NODES_AXIS), grads
+            lambda g: sync(g, NODES_AXIS), grads
         )
         loss = jax.lax.pmean(loss, NODES_AXIS)
         updates, opt_state = opt.update(grads, opt_state, params)
@@ -199,6 +235,8 @@ def run_seq_parallel(args, ctx, n, rng):
                 args.batch_size, args.seq_len
             )
         )
+        if args.striped:
+            ids = stripe_blocks(ids, n)
         params, opt_state, loss = f(params, opt_state, ids)
         l = float(np.asarray(loss).mean())
         first = first if first is not None else l
