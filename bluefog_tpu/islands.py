@@ -1,0 +1,559 @@
+"""Asynchronous islands — true one-sided window ops across processes.
+
+The single-controller emulation (:mod:`bluefog_tpu.windows`) realizes the
+*synchronous schedule* of asynchronous algorithms: all ranks live in one
+process and deposits land at collective exchange points.  This module is the
+documented stretch beyond that (SURVEY.md §7 stage 5): each rank is its own
+OS process — an **island** with its own JAX controller and devices — and
+window deposits travel through a native shared-memory mailbox
+(``native/shm_mailbox.cc``) with genuine passive-target semantics: a
+``win_put`` completes with NO participation by the receiver, ranks step at
+their own pace, and staleness is whatever the wall clock makes it — exactly
+the reference's MPI RMA model (``MPI_Win_lock/Put/flush`` in
+``bluefog/common/mpi_controller.cc`` [U]; SURVEY.md §3.4).
+
+Scope: islands cover the reference's *window* op family (the asynchronous
+algorithms), plus ``barrier`` and a REAL ``win_mutex`` (shared-memory locks —
+the emulation's no-op shim is only valid when there are no concurrent
+writers; islands have them).  Synchronous collectives stay with the
+single-controller SPMD path, which is strictly better for them.  On a
+multi-host TPU pod each island is one host process (the deployment the
+reference runs one MPI rank per GPU); shared memory is the intra-host
+transport, and the same mailbox protocol over DCN is the documented
+extension point.
+
+API shape matches ``bluefog_tpu.windows`` rank-locally: tensors here are
+THIS rank's tensor (no leading ``size`` axis), and weight arguments are
+plain ``{rank: weight}`` dicts — the reference's per-process convention.
+
+Mass conservation: ``win_accumulate`` + ``win_update_then_collect`` use the
+transport's atomic read+zero ``collect``, so asynchronous push-sum conserves
+Σx and Σp under ANY interleaving — the property the reference gets from MPI
+atomicity and that makes x/p debiasing converge to the exact average.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import networkx as nx
+import numpy as np
+
+from bluefog_tpu import topology_util
+from bluefog_tpu.native import shm_native
+from bluefog_tpu.timeline import timeline_context
+
+__all__ = [
+    "init",
+    "shutdown",
+    "initialized",
+    "rank",
+    "size",
+    "barrier",
+    "set_topology",
+    "load_topology",
+    "in_neighbor_ranks",
+    "out_neighbor_ranks",
+    "win_create",
+    "win_free",
+    "win_put",
+    "win_accumulate",
+    "win_get",
+    "win_update",
+    "win_update_then_collect",
+    "win_sync",
+    "win_mutex",
+    "win_associated_p",
+    "win_set_exposed",
+    "push_sum_round",
+    "get_win_version",
+    "turn_on_win_ops_with_associated_p",
+    "turn_off_win_ops_with_associated_p",
+    "spawn",
+]
+
+WeightDict = Optional[Dict[int, float]]
+
+
+class _IslandWindow:
+    def __init__(self, name: str, tensor: np.ndarray, ctx: "_IslandContext",
+                 zero_init: bool):
+        topo = ctx.topology
+        self.name = name
+        self.in_neighbors: List[int] = sorted(topo.predecessors(ctx.rank))
+        self.out_neighbors: List[int] = sorted(topo.successors(ctx.rank))
+        # slot order at EVERY rank must be derivable by every writer: slot k
+        # of rank d is d's k-th in-neighbor in ascending rank order (the
+        # reference's per-writer registered-buffer model, SURVEY §2.4)
+        self.slot_of: Dict[int, Dict[int, int]] = {
+            d: {s: k for k, s in enumerate(sorted(topo.predecessors(d)))}
+            for d in topo.nodes
+        }
+        maxd = max((len(v) for v in self.slot_of.values()), default=0)
+        self.self_tensor = np.array(tensor, copy=True)
+        self.p_self = 1.0
+        self.shm = shm_native.make_window(
+            ctx.job, name, ctx.rank, ctx.size, maxd,
+            tensor.shape, tensor.dtype,
+        )
+        # windows are created collectively (like MPI_Win_create): barrier so
+        # every rank's segment view exists before anyone deposits.  Unless
+        # zero_init, each rank seeds its OWN slots with its OWN tensor (the
+        # reference initializes every in-neighbor buffer from the local
+        # value so a pre-put win_update is a no-op average — see
+        # windows._Window).
+        self.shm.expose(self.self_tensor, self.p_self)
+        if not zero_init:
+            for k in range(len(self.in_neighbors)):
+                self.shm.write(ctx.rank, k, tensor, p=1.0)
+        ctx.shm_job.barrier()
+
+
+class _IslandContext:
+    def __init__(self, rank_: int, size_: int, job: str):
+        self.rank = rank_
+        self.size = size_
+        self.job = job
+        self.topology: nx.DiGraph = topology_util.ExponentialTwoGraph(size_) \
+            if size_ > 1 else _trivial_graph()
+        self.windows: Dict[str, _IslandWindow] = {}
+        self.created_names: set = set()  # for shm unlink at shutdown
+        self.associated_p = False
+        self.shm_job = shm_native.make_job(job, rank_, size_)
+
+
+def _trivial_graph() -> nx.DiGraph:
+    g = nx.DiGraph()
+    g.add_node(0)
+    return g
+
+
+_context: Optional[_IslandContext] = None
+
+
+def _ctx() -> _IslandContext:
+    if _context is None:
+        raise RuntimeError("islands not initialized; call islands.init() "
+                           "(or launch via bftpu-run --islands N)")
+    return _context
+
+
+def init(rank_: Optional[int] = None, size_: Optional[int] = None,
+         job: Optional[str] = None) -> None:
+    """Join the island job.  Arguments default to the env the launcher sets
+    (``BLUEFOG_ISLAND_RANK/SIZE/JOB``) — the analogue of ``bf.init()`` under
+    ``bfrun`` reading MPI env [U]."""
+    global _context
+    if _context is not None:
+        return
+    r = int(os.environ["BLUEFOG_ISLAND_RANK"]) if rank_ is None else int(rank_)
+    n = int(os.environ["BLUEFOG_ISLAND_SIZE"]) if size_ is None else int(size_)
+    j = os.environ.get("BLUEFOG_ISLAND_JOB", "default") if job is None else job
+    if not (0 <= r < n):
+        raise ValueError(f"rank {r} out of range for size {n}")
+    _context = _IslandContext(r, n, j)
+    _context.shm_job.barrier()
+
+
+def shutdown(unlink: bool = False) -> None:
+    """Leave the job; ``unlink=True`` (call on exactly one rank, after a
+    barrier) removes the shm segments."""
+    global _context
+    if _context is None:
+        return
+    for w in _context.windows.values():
+        w.shm.close(unlink=False)
+    names = list(_context.created_names)
+    _context.windows.clear()
+    _context.shm_job.close(unlink=False)
+    if unlink:
+        shm_native.unlink_all(_context.job, names)
+    _context = None
+
+
+def initialized() -> bool:
+    return _context is not None
+
+
+def rank() -> int:
+    return _ctx().rank
+
+
+def size() -> int:
+    return _ctx().size
+
+
+def barrier() -> None:
+    """Explicit global barrier (init/teardown/tests; the async hot loop
+    never calls this — that is the point of islands)."""
+    _ctx().shm_job.barrier()
+
+
+def set_topology(topo: nx.DiGraph) -> bool:
+    """Install the virtual topology.  Must be called identically on every
+    rank BEFORE creating windows (windows snapshot it, as upstream [U])."""
+    ctx = _ctx()
+    if ctx.windows:
+        raise RuntimeError("set_topology with live windows: free them first "
+                           "(windows snapshot their topology, as upstream)")
+    ctx.topology = topo
+    return True
+
+
+def load_topology() -> nx.DiGraph:
+    return _ctx().topology
+
+
+def in_neighbor_ranks() -> List[int]:
+    ctx = _ctx()
+    return sorted(ctx.topology.predecessors(ctx.rank))
+
+
+def out_neighbor_ranks() -> List[int]:
+    ctx = _ctx()
+    return sorted(ctx.topology.successors(ctx.rank))
+
+
+# ---------------------------------------------------------------------------
+# window ops
+# ---------------------------------------------------------------------------
+
+
+def _win(name: str) -> _IslandWindow:
+    w = _ctx().windows.get(name)
+    if w is None:
+        raise KeyError(f"no window named {name!r}; call win_create first")
+    return w
+
+
+def _to_host(tensor) -> np.ndarray:
+    # jax.Array, torch.Tensor (cpu), or array-like → host numpy
+    if hasattr(tensor, "detach"):
+        tensor = tensor.detach().cpu().numpy()
+    return np.asarray(tensor)
+
+
+def win_create(tensor, name: str, zero_init: bool = False) -> bool:
+    """Collectively create a named window from THIS rank's tensor
+    (reference ``bf.win_create`` [U]; collective like MPI_Win_create)."""
+    ctx = _ctx()
+    if name in ctx.windows:
+        return False
+    t = _to_host(tensor)
+    ctx.windows[name] = _IslandWindow(name, t, ctx, zero_init)
+    ctx.created_names.add(name)
+    return True
+
+
+def win_free(name: Optional[str] = None) -> bool:
+    """Free one window (all when ``name`` is None).  COLLECTIVE, like
+    MPI_Win_free [U]: every rank must call it with the same name(s).  The
+    segment is unlinked (rank 0, between two barriers) so a later
+    ``win_create`` under the same name starts from a fresh segment instead
+    of attaching to stale slots."""
+    ctx = _ctx()
+    names = [name] if name is not None else sorted(ctx.windows)
+    ok = True
+    for n in names:
+        w = ctx.windows.pop(n, None)
+        if w is None:
+            ok = False
+            continue
+        w.shm.close(unlink=False)
+        ctx.shm_job.barrier()  # all mappings closed
+        if ctx.rank == 0:
+            shm_native.unlink_segment(ctx.job, f"win_{n}")
+        ctx.shm_job.barrier()  # name gone everywhere before any re-create
+        ctx.created_names.discard(n)
+    return ok
+
+
+def win_put(tensor, name: str, dst_weights: WeightDict = None) -> bool:
+    """One-sided deposit of (optionally per-destination scaled) values into
+    my slot at each out-neighbor — completes without receiver participation
+    (reference ``bf.win_put`` → MPI_Put [U]).  Also refreshes my exposed
+    tensor (upstream the window aliases the tensor's memory)."""
+    with timeline_context("island_win_put"):
+        ctx = _ctx()
+        win = _win(name)
+        t = _to_host(tensor).astype(win.shm.dtype, copy=False)
+        win.self_tensor = np.array(t, copy=True)
+        win.shm.expose(win.self_tensor, win.p_self)
+        targets = win.out_neighbors if dst_weights is None else dst_weights
+        for d in targets:
+            wgt = 1.0 if dst_weights is None else float(dst_weights[d])
+            win.shm.write(d, win.slot_of[d][ctx.rank], t * wgt,
+                          p=win.p_self * wgt, accumulate=False)
+    return True
+
+
+def win_accumulate(tensor, name: str, dst_weights: WeightDict = None) -> bool:
+    """Like win_put but atomically ADDS into the destination slot (reference
+    ``bf.win_accumulate`` → MPI_Accumulate [U]).  With associated-p enabled
+    the scalar mass rides along, so Σ(x, p) over all slots + exposed tensors
+    is invariant — the push-sum conservation law."""
+    with timeline_context("island_win_accumulate"):
+        ctx = _ctx()
+        win = _win(name)
+        t = _to_host(tensor).astype(win.shm.dtype, copy=False)
+        targets = win.out_neighbors if dst_weights is None else dst_weights
+        for d in targets:
+            wgt = 1.0 if dst_weights is None else float(dst_weights[d])
+            win.shm.write(d, win.slot_of[d][ctx.rank], t * wgt,
+                          p=win.p_self * wgt, accumulate=True)
+    return True
+
+
+def win_get(name: str, src_weights: WeightDict = None) -> bool:
+    """One-sided pull of in-neighbors' exposed tensors into my mailbox
+    slots, optionally receiver-scaled (reference ``bf.win_get`` →
+    MPI_Get [U])."""
+    with timeline_context("island_win_get"):
+        ctx = _ctx()
+        win = _win(name)
+        sources = win.in_neighbors if src_weights is None else src_weights
+        for s in sources:
+            wgt = 1.0 if src_weights is None else float(src_weights[s])
+            a, p, _ = win.shm.read_exposed(s)
+            win.shm.write(ctx.rank, win.slot_of[ctx.rank][s], a * wgt,
+                          p=p * wgt, accumulate=False)
+    return True
+
+
+def _resolve_update_weights(win: _IslandWindow, self_weight, neighbor_weights):
+    nbrs = win.in_neighbors
+    if neighbor_weights is not None:
+        unknown = set(neighbor_weights) - set(nbrs)
+        if unknown:
+            raise KeyError(
+                f"neighbor_weights for non-in-neighbor rank(s) {sorted(unknown)}; "
+                f"in-neighbors of rank {_ctx().rank} are {nbrs}"
+            )
+        nw = {s: float(neighbor_weights.get(s, 0.0)) for s in nbrs}
+        sw = (1.0 - sum(nw.values())) if self_weight is None else float(self_weight)
+    else:
+        u = 1.0 / (len(nbrs) + 1)
+        nw = {s: u for s in nbrs}
+        sw = u if self_weight is None else float(self_weight)
+    return sw, nw
+
+
+def win_update(
+    name: str,
+    self_weight: Optional[float] = None,
+    neighbor_weights: WeightDict = None,
+    reset: bool = False,
+    clone: bool = False,
+) -> np.ndarray:
+    """Local weighted combine of my exposed tensor with my mailbox slots
+    (reference ``bf.win_update`` [U]; default uniform 1/(in_degree+1)).
+    ``reset=True`` drains the slots atomically (collect) so in-flight
+    deposits are never lost — the accumulate idiom."""
+    with timeline_context("island_win_update"):
+        ctx = _ctx()
+        win = _win(name)
+        sw, nw = _resolve_update_weights(win, self_weight, neighbor_weights)
+        wdt = (win.shm.dtype if np.issubdtype(win.shm.dtype, np.inexact)
+               else np.float64)
+        acc = win.self_tensor.astype(wdt) * sw
+        p_acc = sw * win.p_self
+        for s in win.in_neighbors:
+            a, p, _ = win.shm.read(win.slot_of[ctx.rank][s], collect=reset)
+            acc = acc + nw[s] * a.astype(wdt)
+            p_acc = p_acc + nw[s] * p
+        win.self_tensor = acc.astype(win.shm.dtype)
+        if ctx.associated_p:
+            win.p_self = float(p_acc)
+        win.shm.expose(win.self_tensor, win.p_self)
+        out = win.self_tensor
+        return np.array(out, copy=True) if clone else out
+
+
+def win_update_then_collect(name: str, require_mutex: bool = False) -> np.ndarray:
+    """Self weight 1, every neighbor slot weight 1, atomic drain — the
+    push-sum accumulate-and-drain idiom (reference
+    ``bf.win_update_then_collect`` [U]).  ``require_mutex`` is honored with
+    the REAL shared-memory mutex (unlike the bulk-synchronous shim)."""
+    win = _win(name)
+    ones = {s: 1.0 for s in win.in_neighbors}
+    cm = win_mutex(name, for_self=True) if require_mutex else contextlib.nullcontext()
+    with cm:
+        return win_update(name, self_weight=1.0, neighbor_weights=ones,
+                          reset=True)
+
+
+def win_sync(name: str) -> np.ndarray:
+    """My current tensor without combining (reference ``bf.win_sync``-style
+    read of the window copy [U])."""
+    return _win(name).self_tensor
+
+
+@contextlib.contextmanager
+def win_mutex(name: str, for_self: bool = False,
+              ranks: Optional[Sequence[int]] = None):
+    """REAL cross-process mutual exclusion over shared-memory locks
+    (reference ``bf.win_mutex`` — MPI lock-based [U]).  Default locks my
+    out-neighbors (the ranks whose windows I am about to touch); always
+    acquired in ascending rank order to prevent deadlock."""
+    del name
+    ctx = _ctx()
+    targets = set(ranks) if ranks is not None else set(out_neighbor_ranks())
+    if for_self:
+        targets.add(ctx.rank)
+    ordered = sorted(targets)
+    acquired = []
+    try:
+        for r in ordered:
+            ctx.shm_job.mutex_acquire(r)
+            acquired.append(r)
+        yield
+    finally:
+        for r in reversed(acquired):
+            ctx.shm_job.mutex_release(r)
+
+
+def win_associated_p(name: str) -> float:
+    return _win(name).p_self
+
+
+def win_set_exposed(name: str, tensor, associated_p: Optional[float] = None) -> None:
+    """Overwrite my exposed tensor (and optionally p) without a put — the
+    push-sum debias-and-restart idiom (see windows.win_set_exposed)."""
+    win = _win(name)
+    t = _to_host(tensor).astype(win.shm.dtype, copy=False)
+    if t.shape != win.shm.shape:
+        raise ValueError(f"shape {t.shape} != window shape {win.shm.shape}")
+    win.self_tensor = np.array(t, copy=True)
+    if associated_p is not None:
+        win.p_self = float(associated_p)
+    win.shm.expose(win.self_tensor, win.p_self)
+
+
+def get_win_version(name: str) -> Dict[int, int]:
+    """{in_neighbor: deposit_count} for MY slots (reference
+    ``bf.get_win_version`` [U], rank-local view)."""
+    ctx = _ctx()
+    win = _win(name)
+    return {
+        s: win.shm.read_version(win.slot_of[ctx.rank][s])
+        for s in win.in_neighbors
+    }
+
+
+def push_sum_round(name: str, dst_weights: WeightDict = None) -> np.ndarray:
+    """One mass-conserving asynchronous push-sum round (Kempe et al.; the
+    algorithm the reference's ``win_accumulate`` + associated-p machinery
+    exists for — ``examples/pytorch_optimization.py`` push-sum loops [U]).
+
+    Splits my (x, p) mass into equal shares over {self} ∪ out-neighbors
+    (or per ``dst_weights``, which must sum with the kept share to 1),
+    deposits the neighbor shares atomically, keeps my share, then drains my
+    mailbox.  Ordering matters: the deposit must read (x, p) BEFORE the kept
+    share is written back, else the ride-along p is double-scaled.  Under
+    any interleaving Σx and Σp over all ranks' (exposed + slots) are
+    invariant, so ``win_sync(name) / win_associated_p(name)`` converges to
+    the exact global average with NO synchronization.
+
+    Requires associated-p mode; enables it if off.
+    """
+    ctx = _ctx()
+    if not ctx.associated_p:
+        ctx.associated_p = True
+    win = _win(name)
+    cur = win.self_tensor
+    p = win.p_self
+    if dst_weights is None:
+        share = 1.0 / (len(win.out_neighbors) + 1)
+        dst_weights = {d: share for d in win.out_neighbors}
+        keep = share
+    else:
+        keep = 1.0 - sum(dst_weights.values())
+    win_accumulate(cur, name, dst_weights=dst_weights)
+    win_set_exposed(name, cur * keep, p * keep)
+    return win_update_then_collect(name)
+
+
+def turn_on_win_ops_with_associated_p() -> None:
+    _ctx().associated_p = True
+
+
+def turn_off_win_ops_with_associated_p() -> None:
+    _ctx().associated_p = False
+
+
+# ---------------------------------------------------------------------------
+# process spawner (used by bftpu-run --islands and the tests)
+# ---------------------------------------------------------------------------
+
+
+def _spawn_worker(fn, r, nranks, job, args, q):
+    try:
+        init(r, nranks, job)
+        out = fn(r, nranks, *args)
+    except Exception as e:  # noqa: BLE001 - report to parent
+        import traceback
+
+        q.put((r, False, f"{e}\n{traceback.format_exc()}"))
+        return
+    # report BEFORE the teardown barrier: if a sibling died, the barrier
+    # never completes and the parent reaps us after collecting results
+    q.put((r, True, out))
+    barrier()
+    shutdown(unlink=(r == 0))
+
+
+def spawn(fn, nranks: int, job: Optional[str] = None, timeout: float = 120.0,
+          args: Tuple = (), method: str = "spawn") -> List:
+    """Run ``fn(rank, size, *args)`` in ``nranks`` processes, each
+    auto-``init``-ed; returns the per-rank return values in rank order.  The
+    miniature in-process ``bfrun``: tests and notebooks use this, production
+    uses ``bftpu-run --islands`` (one process per host).
+
+    ``method`` is the multiprocessing start method: the default "spawn" is
+    safe after the parent has touched JAX (fresh interpreter per island —
+    and an island owning its own runtime is the semantics anyway); "fork" is
+    faster for JAX-free parents.  Under "spawn", ``fn`` must be a picklable
+    top-level function.  Raises on any child failure.
+    """
+    import multiprocessing as mp
+
+    job = job or (
+        f"spawn{os.getpid()}_"
+        f"{abs(hash((getattr(fn, '__name__', 'fn'), nranks))) % 10**6}"
+    )
+    mp_ctx = mp.get_context(method)
+    q = mp_ctx.Queue()
+    procs = [
+        mp_ctx.Process(target=_spawn_worker, args=(fn, r, nranks, job, args, q))
+        for r in range(nranks)
+    ]
+    for p in procs:
+        p.start()
+    results: Dict[int, object] = {}
+    failures = []
+    for _ in range(nranks):
+        try:
+            r, ok, out = q.get(timeout=timeout)
+        except Exception:
+            failures.append("timeout waiting for island results")
+            break
+        if ok:
+            results[r] = out
+        else:
+            failures.append(f"rank {r}: {out}")
+    if failures:
+        # siblings of a failed rank may be stuck at the teardown barrier
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+    for p in procs:
+        p.join(timeout=10)
+        if p.is_alive():
+            p.terminate()
+            failures.append("child did not exit")
+    if failures:
+        shm_native.unlink_all(job, [])
+        raise RuntimeError("island spawn failed:\n" + "\n".join(failures))
+    return [results[r] for r in range(nranks)]

@@ -123,6 +123,49 @@ def _declare_abi(lib: ctypes.CDLL) -> None:
         ]
         lib.bf_loader_destroy.restype = None
         lib.bf_loader_destroy.argtypes = [ctypes.c_void_p]
+        # shm mailbox ABI (async island window transport)
+        lib.bf_shm_job_create.restype = ctypes.c_void_p
+        lib.bf_shm_job_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+        ]
+        lib.bf_shm_job_barrier.restype = None
+        lib.bf_shm_job_barrier.argtypes = [ctypes.c_void_p]
+        lib.bf_shm_job_mutex_acquire.restype = None
+        lib.bf_shm_job_mutex_acquire.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.bf_shm_job_mutex_release.restype = None
+        lib.bf_shm_job_mutex_release.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.bf_shm_job_destroy.restype = None
+        lib.bf_shm_job_destroy.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.bf_shm_win_create.restype = ctypes.c_void_p
+        lib.bf_shm_win_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+        ]
+        lib.bf_shm_win_write.restype = None
+        lib.bf_shm_win_write.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_double, ctypes.c_int32,
+        ]
+        lib.bf_shm_win_read.restype = ctypes.c_int64
+        lib.bf_shm_win_read.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int32,
+        ]
+        lib.bf_shm_win_reset.restype = None
+        lib.bf_shm_win_reset.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.bf_shm_win_expose.restype = None
+        lib.bf_shm_win_expose.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_double,
+        ]
+        lib.bf_shm_win_read_exposed.restype = ctypes.c_int64
+        lib.bf_shm_win_read_exposed.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.bf_shm_win_destroy.restype = None
+        lib.bf_shm_win_destroy.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.bf_shm_unlink.restype = None
+        lib.bf_shm_unlink.argtypes = [ctypes.c_char_p]
         # layout optimizer ABI
         lib.bf_layout_anneal.restype = ctypes.c_double
         lib.bf_layout_anneal.argtypes = [

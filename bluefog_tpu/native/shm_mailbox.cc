@@ -1,0 +1,448 @@
+// Shared-memory mailbox transport for asynchronous "island" window ops.
+//
+// TPU-native sibling of the reference's passive-target MPI RMA layer
+// (MPI_Win_create / MPI_Put / MPI_Accumulate / MPI_Win_lock in
+// bluefog/common/mpi_controller.cc and mpi_context.cc [U]; SURVEY.md §2.4,
+// §3.4).  The single-controller emulation in bluefog_tpu/windows.py realizes
+// the synchronous schedule of asynchronous algorithms; THIS module supplies
+// the missing piece — true one-sided deposits between independently-stepping
+// OS processes ("islands"), each of which owns its own JAX controller and
+// device set.  A writer deposits into its dedicated slot at the destination
+// with NO participation by the receiver, exactly the reference's window
+// model: one registered buffer per in-neighbor per named window, so
+// concurrent writers never collide.
+//
+// Memory layout of a window segment (POSIX shm, /dev/shm):
+//
+//   Header  { magic, nranks, maxd, nbytes, dtype, init_done, attached }
+//   Exposed [nranks]        — each rank's currently-exposed tensor
+//   Mail    [nranks][maxd]  — slot (d, k): last deposit from d's k-th
+//                             in-neighbor (ascending rank order)
+//
+// Every slot is a small header + 64-byte-aligned payload:
+//
+//   Slot { lock, seq, version, p, payload[nbytes] }
+//
+// Concurrency protocol (the part MPI gives the reference for free):
+//   - writers (put / accumulate / reset / collect) take the slot spinlock,
+//     then bump `seq` to odd, mutate, bump to even (seqlock publish);
+//   - plain readers never lock: they spin on `seq` until they observe the
+//     same even value before and after the copy — wait-free w.r.t. writers;
+//   - `collect` (read + zero in one critical section) is the atomic drain
+//     that makes asynchronous push-sum mass-conserving: a deposit can never
+//     land between the read and the zero.
+//
+// A tiny per-job segment provides a sense-reversing barrier (init/teardown
+// and tests only — the async hot loop never barriers) and per-rank mutexes
+// implementing a REAL bf.win_mutex for island mode (the bulk-synchronous
+// emulation's no-op shim is justified only when there are no concurrent
+// writers; islands have them).
+//
+// C++17, no external deps; C-linkage ABI consumed by ctypes
+// (bluefog_tpu/native/shm_native.py).
+
+#include <atomic>
+#include <cerrno>
+#include <cstddef>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sched.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x42464d41494c4258ull;  // "BFMAILBX"
+
+inline int64_t align_up(int64_t v, int64_t a) { return (v + a - 1) / a * a; }
+
+inline void cpu_relax() { sched_yield(); }
+
+// ---------------------------------------------------------------------------
+// shm segment plumbing
+// ---------------------------------------------------------------------------
+
+struct Segment {
+  void* base = nullptr;
+  int64_t bytes = 0;
+  char name[256];
+};
+
+// Open-or-create a named segment of exactly `bytes`.  The winner of the
+// O_EXCL race sizes + zeroes it and must later publish readiness itself via
+// publish_init() — AFTER writing any header fields — so no attacher ever
+// observes a half-initialized header; losers attach and spin on the flag at
+// offset `init_off`.
+bool segment_open(Segment* seg, const char* name, int64_t bytes,
+                  int64_t init_off, bool* creator_out) {
+  std::snprintf(seg->name, sizeof(seg->name), "%s", name);
+  bool creator = false;
+  int fd = shm_open(name, O_RDWR | O_CREAT | O_EXCL, 0600);
+  if (fd >= 0) {
+    creator = true;
+    if (ftruncate(fd, bytes) != 0) {
+      close(fd);
+      shm_unlink(name);
+      return false;
+    }
+  } else {
+    if (errno != EEXIST) return false;
+    // attach; the creator may still be mid-ftruncate, so wait for full size
+    fd = shm_open(name, O_RDWR, 0600);
+    if (fd < 0) return false;
+    struct stat st;
+    for (int spin = 0; ; ++spin) {
+      if (fstat(fd, &st) != 0) { close(fd); return false; }
+      if (st.st_size >= bytes) break;
+      if (spin > 2000000) { close(fd); return false; }
+      cpu_relax();
+    }
+  }
+  void* base = mmap(nullptr, static_cast<size_t>(bytes),
+                    PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) return false;
+  seg->base = base;
+  seg->bytes = bytes;
+  auto* flag = reinterpret_cast<std::atomic<uint64_t>*>(
+      static_cast<char*>(base) + init_off);
+  if (!creator) {
+    for (int spin = 0; flag->load(std::memory_order_acquire) != 1; ++spin) {
+      if (spin > 2000000) { munmap(base, bytes); return false; }
+      cpu_relax();
+    }
+  }
+  // creator: mapping of a fresh shm object is zero-filled; caller fills the
+  // header then calls publish_init
+  if (creator_out) *creator_out = creator;
+  return true;
+}
+
+void publish_init(void* base, int64_t init_off) {
+  reinterpret_cast<std::atomic<uint64_t>*>(static_cast<char*>(base) +
+                                           init_off)
+      ->store(1, std::memory_order_release);
+}
+
+void segment_close(Segment* seg, bool unlink_seg) {
+  if (seg->base) munmap(seg->base, static_cast<size_t>(seg->bytes));
+  if (unlink_seg) shm_unlink(seg->name);
+  seg->base = nullptr;
+}
+
+// ---------------------------------------------------------------------------
+// job segment: barrier + per-rank mutexes
+// ---------------------------------------------------------------------------
+
+struct JobHeader {
+  std::atomic<uint64_t> init_done;
+  int64_t nranks;
+  std::atomic<uint64_t> arrived;
+  std::atomic<uint64_t> generation;
+  // nranks mutexes follow (one cache line each)
+};
+
+struct JobMutex {
+  std::atomic<uint32_t> locked;
+  char pad[60];
+};
+
+struct Job {
+  Segment seg;
+  int64_t rank = 0;
+  int64_t nranks = 0;
+  JobHeader* hdr() { return static_cast<JobHeader*>(seg.base); }
+  JobMutex* mutexes() {
+    return reinterpret_cast<JobMutex*>(static_cast<char*>(seg.base) +
+                                       align_up(sizeof(JobHeader), 64));
+  }
+};
+
+// ---------------------------------------------------------------------------
+// window segment
+// ---------------------------------------------------------------------------
+
+struct WinHeader {
+  uint64_t magic;
+  std::atomic<uint64_t> init_done;
+  int64_t nranks;
+  int64_t maxd;
+  int64_t nbytes;
+  int32_t dtype;  // 0 raw bytes, 1 float32, 2 float64
+};
+
+struct SlotHeader {
+  std::atomic<uint32_t> lock;  // writer spinlock
+  uint32_t pad0;
+  std::atomic<uint64_t> seq;   // seqlock: odd while a writer mutates
+  uint64_t version;            // deposit count
+  double p;                    // push-sum associated scalar
+};
+
+struct Window {
+  Segment seg;
+  int64_t rank = 0;
+  int64_t nranks = 0;
+  int64_t maxd = 0;
+  int64_t nbytes = 0;
+  int32_t dtype = 0;
+  int64_t slot_stride = 0;
+  int64_t slots_off = 0;  // exposed slots start; mail follows
+
+  char* slot_at(int64_t index) {
+    return static_cast<char*>(seg.base) + slots_off + index * slot_stride;
+  }
+  // exposed slot of rank r
+  char* exposed(int64_t r) { return slot_at(r); }
+  // mailbox slot (dst d, in-neighbor position k)
+  char* mail(int64_t d, int64_t k) {
+    return slot_at(nranks + d * maxd + k);
+  }
+};
+
+inline char* payload_of(char* slot) {
+  return slot + align_up(sizeof(SlotHeader), 64);
+}
+
+void slot_lock(SlotHeader* s) {
+  uint32_t expected = 0;
+  while (!s->lock.compare_exchange_weak(expected, 1,
+                                        std::memory_order_acquire,
+                                        std::memory_order_relaxed)) {
+    expected = 0;
+    cpu_relax();
+  }
+}
+
+void slot_unlock(SlotHeader* s) {
+  s->lock.store(0, std::memory_order_release);
+}
+
+// Mutate a slot under lock + seqlock publish.
+template <typename F>
+void slot_write(char* slot, F&& mutate) {
+  auto* s = reinterpret_cast<SlotHeader*>(slot);
+  slot_lock(s);
+  uint64_t seq = s->seq.load(std::memory_order_relaxed);
+  s->seq.store(seq + 1, std::memory_order_relaxed);  // odd: in progress
+  // full fence: the payload stores must not become visible before the odd
+  // seq store (store-store barrier — smp_wmb in the kernel's seqlock; a
+  // release fence would NOT order the later plain stores on ARM)
+  std::atomic_thread_fence(std::memory_order_seq_cst);
+  mutate(s, payload_of(slot));
+  // release store: all payload stores visible before seq turns even
+  std::atomic_thread_fence(std::memory_order_release);
+  s->seq.store(seq + 2, std::memory_order_release);
+  slot_unlock(s);
+}
+
+// Seqlock read (no lock taken): retry until a stable even seq brackets the
+// copy.  Returns the observed version.
+int64_t slot_read(char* slot, void* out, int64_t nbytes, double* p_out) {
+  auto* s = reinterpret_cast<SlotHeader*>(slot);
+  for (;;) {
+    uint64_t before = s->seq.load(std::memory_order_acquire);
+    if (before & 1) { cpu_relax(); continue; }
+    uint64_t version = s->version;
+    double p = s->p;
+    if (out) std::memcpy(out, payload_of(slot), static_cast<size_t>(nbytes));
+    std::atomic_thread_fence(std::memory_order_acquire);
+    uint64_t after = s->seq.load(std::memory_order_acquire);
+    if (before == after) {
+      if (p_out) *p_out = p;
+      return static_cast<int64_t>(version);
+    }
+    cpu_relax();
+  }
+}
+
+void accumulate_payload(char* dst, const void* src, int64_t nbytes,
+                        int32_t dtype) {
+  if (dtype == 1) {
+    auto* d = reinterpret_cast<float*>(dst);
+    auto* s = static_cast<const float*>(src);
+    int64_t n = nbytes / static_cast<int64_t>(sizeof(float));
+    for (int64_t i = 0; i < n; ++i) d[i] += s[i];
+  } else if (dtype == 2) {
+    auto* d = reinterpret_cast<double*>(dst);
+    auto* s = static_cast<const double*>(src);
+    int64_t n = nbytes / static_cast<int64_t>(sizeof(double));
+    for (int64_t i = 0; i < n; ++i) d[i] += s[i];
+  } else {
+    std::memcpy(dst, src, static_cast<size_t>(nbytes));  // raw: overwrite
+  }
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* bf_shm_job_create(const char* name, int64_t rank, int64_t nranks) {
+  auto* job = new Job;
+  job->rank = rank;
+  job->nranks = nranks;
+  int64_t bytes = align_up(sizeof(JobHeader), 64) +
+                  nranks * static_cast<int64_t>(sizeof(JobMutex));
+  bool creator = false;
+  if (!segment_open(&job->seg, name, bytes,
+                    offsetof(JobHeader, init_done), &creator)) {
+    delete job;
+    return nullptr;
+  }
+  if (creator) {
+    job->hdr()->nranks = nranks;
+    publish_init(job->seg.base, offsetof(JobHeader, init_done));
+  }
+  return job;
+}
+
+void bf_shm_job_barrier(void* h) {
+  auto* job = static_cast<Job*>(h);
+  auto* hdr = job->hdr();
+  uint64_t gen = hdr->generation.load(std::memory_order_acquire);
+  uint64_t arrived = hdr->arrived.fetch_add(1, std::memory_order_acq_rel) + 1;
+  if (arrived == static_cast<uint64_t>(job->nranks)) {
+    hdr->arrived.store(0, std::memory_order_relaxed);
+    hdr->generation.fetch_add(1, std::memory_order_acq_rel);
+  } else {
+    while (hdr->generation.load(std::memory_order_acquire) == gen) cpu_relax();
+  }
+}
+
+void bf_shm_job_mutex_acquire(void* h, int64_t target_rank) {
+  auto* job = static_cast<Job*>(h);
+  auto& m = job->mutexes()[target_rank].locked;
+  uint32_t expected = 0;
+  while (!m.compare_exchange_weak(expected, 1, std::memory_order_acquire,
+                                  std::memory_order_relaxed)) {
+    expected = 0;
+    cpu_relax();
+  }
+}
+
+void bf_shm_job_mutex_release(void* h, int64_t target_rank) {
+  auto* job = static_cast<Job*>(h);
+  job->mutexes()[target_rank].locked.store(0, std::memory_order_release);
+}
+
+void bf_shm_job_destroy(void* h, int32_t unlink_seg) {
+  auto* job = static_cast<Job*>(h);
+  segment_close(&job->seg, unlink_seg != 0);
+  delete job;
+}
+
+void* bf_shm_win_create(const char* name, int64_t rank, int64_t nranks,
+                        int64_t maxd, int64_t nbytes, int32_t dtype) {
+  auto* win = new Window;
+  win->rank = rank;
+  win->nranks = nranks;
+  win->maxd = maxd < 1 ? 1 : maxd;
+  win->nbytes = nbytes;
+  win->dtype = dtype;
+  win->slot_stride =
+      align_up(sizeof(SlotHeader), 64) + align_up(nbytes, 64);
+  win->slots_off = align_up(sizeof(WinHeader), 64);
+  int64_t nslots = nranks + nranks * win->maxd;
+  int64_t bytes = win->slots_off + nslots * win->slot_stride;
+  bool creator = false;
+  if (!segment_open(&win->seg, name, bytes,
+                    offsetof(WinHeader, init_done), &creator)) {
+    delete win;
+    return nullptr;
+  }
+  auto* hdr = static_cast<WinHeader*>(win->seg.base);
+  if (creator) {
+    hdr->magic = kMagic;
+    hdr->nranks = nranks;
+    hdr->maxd = win->maxd;
+    hdr->nbytes = nbytes;
+    hdr->dtype = dtype;
+    publish_init(win->seg.base, offsetof(WinHeader, init_done));
+  } else if (hdr->magic != kMagic || hdr->nranks != nranks ||
+             hdr->maxd != win->maxd || hdr->nbytes != nbytes) {
+    segment_close(&win->seg, false);
+    delete win;
+    return nullptr;
+  }
+  return win;
+}
+
+// Deposit into (dst, slot).  mode 0 = put (overwrite), 1 = accumulate.
+// p rides along (overwritten or accumulated to match).
+void bf_shm_win_write(void* h, int64_t dst, int64_t slot, const void* data,
+                      double p, int32_t mode) {
+  auto* win = static_cast<Window*>(h);
+  slot_write(win->mail(dst, slot), [&](SlotHeader* s, char* payload) {
+    if (mode == 1) {
+      accumulate_payload(payload, data, win->nbytes, win->dtype);
+      s->p += p;
+    } else {
+      std::memcpy(payload, data, static_cast<size_t>(win->nbytes));
+      s->p = p;
+    }
+    s->version += 1;
+  });
+}
+
+// Read my own mailbox slot `slot`.  collect != 0 drains it atomically
+// (read + zero in one critical section — the push-sum mass-conservation
+// primitive).  Returns the deposit count observed.
+int64_t bf_shm_win_read(void* h, int64_t slot, void* out, double* p,
+                        int32_t collect) {
+  auto* win = static_cast<Window*>(h);
+  char* sl = win->mail(win->rank, slot);
+  if (!collect) return slot_read(sl, out, win->nbytes, p);
+  int64_t version = 0;
+  slot_write(sl, [&](SlotHeader* s, char* payload) {
+    if (out) std::memcpy(out, payload, static_cast<size_t>(win->nbytes));
+    if (p) *p = s->p;
+    version = static_cast<int64_t>(s->version);
+    std::memset(payload, 0, static_cast<size_t>(win->nbytes));
+    s->p = 0.0;
+  });
+  return version;
+}
+
+// Overwrite a mailbox slot's payload+p without touching version — the
+// owner-side reset (reference win_update(reset=True) zeroing its buffers).
+void bf_shm_win_reset(void* h, int64_t slot) {
+  auto* win = static_cast<Window*>(h);
+  slot_write(win->mail(win->rank, slot), [&](SlotHeader* s, char* payload) {
+    std::memset(payload, 0, static_cast<size_t>(win->nbytes));
+    s->p = 0.0;
+  });
+}
+
+// Publish my exposed tensor (what win_get by a neighbor observes).
+void bf_shm_win_expose(void* h, const void* data, double p) {
+  auto* win = static_cast<Window*>(h);
+  slot_write(win->exposed(win->rank), [&](SlotHeader* s, char* payload) {
+    std::memcpy(payload, data, static_cast<size_t>(win->nbytes));
+    s->p = p;
+    s->version += 1;
+  });
+}
+
+// One-sided read of any rank's exposed tensor (the MPI_Get path).
+int64_t bf_shm_win_read_exposed(void* h, int64_t src, void* out, double* p) {
+  auto* win = static_cast<Window*>(h);
+  return slot_read(win->exposed(src), out, win->nbytes, p);
+}
+
+void bf_shm_win_destroy(void* h, int32_t unlink_seg) {
+  auto* win = static_cast<Window*>(h);
+  segment_close(&win->seg, unlink_seg != 0);
+  delete win;
+}
+
+void bf_shm_unlink(const char* name) { shm_unlink(name); }
+
+}  // extern "C"

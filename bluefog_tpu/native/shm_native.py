@@ -1,0 +1,429 @@
+"""Shared-memory mailbox veneer (shm_mailbox.cc) + pure-Python fallback.
+
+Process-to-process transport for the asynchronous island window ops
+(:mod:`bluefog_tpu.islands`) — the TPU-native sibling of the reference's
+passive-target MPI RMA windows (``MPI_Win_create/Put/Accumulate/lock`` in
+``bluefog/common/mpi_controller.cc`` [U]).  The native path is a seqlock
+mailbox in POSIX shm (readers wait-free, writers per-slot spinlocked, an
+atomic read+zero ``collect`` for mass-conserving push-sum).  The fallback
+implements the same interface over an mmap'd file with ``fcntl.lockf``
+byte-range locks — slower, zero native deps, used when the .so is absent.
+
+Both paths share slot geometry: per window, ``nranks`` exposed slots (the
+owner-published tensor ``win_get`` reads) followed by ``nranks × maxd``
+mailbox slots (slot ``(d, k)`` = last deposit from d's k-th in-neighbor).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import re
+import struct
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from bluefog_tpu.native import get_lib
+
+_DTYPE_CODES = {np.dtype(np.float32): 1, np.dtype(np.float64): 2}
+
+
+def seg_name(job: str, suffix: str) -> str:
+    """Sanitized POSIX shm object name (leading slash, [A-Za-z0-9_.-])."""
+    raw = f"bf_{job}_{suffix}"
+    return "/" + re.sub(r"[^A-Za-z0-9_.-]", "_", raw)[:250]
+
+
+def _as_contiguous(array, dtype) -> np.ndarray:
+    a = np.asarray(array, dtype=dtype)
+    return np.ascontiguousarray(a)
+
+
+# ---------------------------------------------------------------------------
+# native path
+# ---------------------------------------------------------------------------
+
+
+class NativeShmJob:
+    """Job-scope segment: sense-reversing barrier + per-rank mutexes."""
+
+    def __init__(self, job: str, rank: int, nranks: int):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._name = seg_name(job, "job")
+        self._h = lib.bf_shm_job_create(self._name.encode(), rank, nranks)
+        if not self._h:
+            raise RuntimeError(f"could not create shm job segment {self._name}")
+
+    def barrier(self) -> None:
+        self._lib.bf_shm_job_barrier(self._h)
+
+    def mutex_acquire(self, rank: int) -> None:
+        self._lib.bf_shm_job_mutex_acquire(self._h, int(rank))
+
+    def mutex_release(self, rank: int) -> None:
+        self._lib.bf_shm_job_mutex_release(self._h, int(rank))
+
+    def close(self, unlink: bool = False) -> None:
+        if self._h:
+            self._lib.bf_shm_job_destroy(self._h, 1 if unlink else 0)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeShmWindow:
+    """One named window: exposed slots + per-in-neighbor mailbox slots."""
+
+    def __init__(self, job: str, name: str, rank: int, nranks: int,
+                 maxd: int, shape: Tuple[int, ...], dtype):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self.nbytes = int(np.prod(self.shape, dtype=np.int64)) * self.dtype.itemsize
+        self._code = _DTYPE_CODES.get(self.dtype, 0)
+        self._name = seg_name(job, f"win_{name}")
+        self._h = lib.bf_shm_win_create(
+            self._name.encode(), rank, nranks, max(maxd, 1), self.nbytes,
+            self._code,
+        )
+        if not self._h:
+            raise RuntimeError(f"could not create shm window {self._name}")
+
+    def write(self, dst: int, slot: int, array, p: float = 1.0,
+              accumulate: bool = False) -> None:
+        if accumulate and self._code == 0:
+            raise TypeError(f"accumulate unsupported for dtype {self.dtype}")
+        a = _as_contiguous(array, self.dtype)
+        self._lib.bf_shm_win_write(
+            self._h, int(dst), int(slot),
+            a.ctypes.data_as(ctypes.c_void_p), float(p),
+            1 if accumulate else 0,
+        )
+
+    def read(self, slot: int, collect: bool = False):
+        out = np.empty(self.shape, dtype=self.dtype)
+        p = ctypes.c_double(0.0)
+        version = self._lib.bf_shm_win_read(
+            self._h, int(slot), out.ctypes.data_as(ctypes.c_void_p),
+            ctypes.byref(p), 1 if collect else 0,
+        )
+        return out, p.value, int(version)
+
+    def read_version(self, slot: int) -> int:
+        # metadata-only probe: NULL out pointer skips the payload copy
+        return int(self._lib.bf_shm_win_read(self._h, int(slot), None, None, 0))
+
+    def reset(self, slot: int) -> None:
+        self._lib.bf_shm_win_reset(self._h, int(slot))
+
+    def expose(self, array, p: float = 1.0) -> None:
+        a = _as_contiguous(array, self.dtype)
+        self._lib.bf_shm_win_expose(
+            self._h, a.ctypes.data_as(ctypes.c_void_p), float(p)
+        )
+
+    def read_exposed(self, src: int):
+        out = np.empty(self.shape, dtype=self.dtype)
+        p = ctypes.c_double(0.0)
+        version = self._lib.bf_shm_win_read_exposed(
+            self._h, int(src), out.ctypes.data_as(ctypes.c_void_p),
+            ctypes.byref(p),
+        )
+        return out, p.value, int(version)
+
+    def close(self, unlink: bool = False) -> None:
+        if self._h:
+            self._lib.bf_shm_win_destroy(self._h, 1 if unlink else 0)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# pure-Python fallback (mmap + fcntl byte-range locks)
+# ---------------------------------------------------------------------------
+
+_FALLBACK_DIR = os.environ.get("BLUEFOG_SHM_DIR", "/dev/shm")
+
+
+class _FallbackSegment:
+    """mmap'd file; every slot guarded by an exclusive lockf range.
+
+    Creation needs no handshake: all ranks ftruncate to the same size
+    (idempotent, zero-fills) and zeros are a valid initial state.
+    """
+
+    def __init__(self, path: str, nbytes: int):
+        self.path = path
+        self._fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
+        os.ftruncate(self._fd, nbytes)
+        self._mm = mmap.mmap(self._fd, nbytes)
+
+    def lock(self, start: int, length: int):
+        import fcntl
+
+        fcntl.lockf(self._fd, fcntl.LOCK_EX, length, start)
+
+    def unlock(self, start: int, length: int):
+        import fcntl
+
+        fcntl.lockf(self._fd, fcntl.LOCK_UN, length, start)
+
+    def close(self, unlink: bool = False):
+        if self._mm is not None:
+            self._mm.close()
+            os.close(self._fd)
+            self._mm = None
+            if unlink:
+                try:
+                    os.unlink(self.path)
+                except OSError:
+                    pass
+
+
+class FallbackShmJob:
+    """Barrier + mutexes over lockf.  Layout: [arrived u64][generation u64]
+    then one lock byte per rank (the mutex is the held lockf range)."""
+
+    def __init__(self, job: str, rank: int, nranks: int):
+        self.nranks = nranks
+        path = os.path.join(_FALLBACK_DIR, seg_name(job, "job")[1:])
+        self._seg = _FallbackSegment(path, 16 + nranks)
+
+    def barrier(self) -> None:
+        mm = self._seg._mm
+        self._seg.lock(0, 16)
+        gen = struct.unpack_from("<Q", mm, 8)[0]
+        arrived = struct.unpack_from("<Q", mm, 0)[0] + 1
+        if arrived == self.nranks:
+            struct.pack_into("<Q", mm, 0, 0)
+            struct.pack_into("<Q", mm, 8, gen + 1)
+            self._seg.unlock(0, 16)
+            return
+        struct.pack_into("<Q", mm, 0, arrived)
+        self._seg.unlock(0, 16)
+        while True:
+            self._seg.lock(8, 8)
+            cur = struct.unpack_from("<Q", mm, 8)[0]
+            self._seg.unlock(8, 8)
+            if cur != gen:
+                return
+            time.sleep(0.0002)
+
+    def mutex_acquire(self, rank: int) -> None:
+        self._seg.lock(16 + rank, 1)
+
+    def mutex_release(self, rank: int) -> None:
+        self._seg.unlock(16 + rank, 1)
+
+    def close(self, unlink: bool = False) -> None:
+        self._seg.close(unlink)
+
+
+class FallbackShmWindow:
+    """Same slot geometry as the native window; every op takes the slot's
+    exclusive lock (no seqlock — simplicity over read throughput)."""
+
+    _HDR = 16  # per-slot: [version u64][p f64]
+
+    def __init__(self, job: str, name: str, rank: int, nranks: int,
+                 maxd: int, shape: Tuple[int, ...], dtype):
+        self.rank = rank
+        self.nranks = nranks
+        self.maxd = max(maxd, 1)
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self.nbytes = int(np.prod(self.shape, dtype=np.int64)) * self.dtype.itemsize
+        self._stride = self._HDR + ((self.nbytes + 63) // 64) * 64
+        nslots = nranks + nranks * self.maxd
+        path = os.path.join(_FALLBACK_DIR, seg_name(job, f"win_{name}")[1:])
+        self._seg = _FallbackSegment(path, nslots * self._stride)
+
+    def _off(self, index: int) -> int:
+        return index * self._stride
+
+    def _mail_index(self, d: int, k: int) -> int:
+        return self.nranks + d * self.maxd + k
+
+    def _read_slot(self, off: int):
+        mm = self._seg._mm
+        version, p = struct.unpack_from("<Qd", mm, off)
+        a = np.frombuffer(
+            mm, dtype=self.dtype,
+            count=self.nbytes // self.dtype.itemsize,
+            offset=off + self._HDR,
+        ).reshape(self.shape).copy()
+        return a, p, version
+
+    def _locked(self, index: int):
+        off = self._off(index)
+        self._seg.lock(off, self._stride)
+        return off
+
+    def _unlock(self, index: int):
+        self._seg.unlock(self._off(index), self._stride)
+
+    def write(self, dst: int, slot: int, array, p: float = 1.0,
+              accumulate: bool = False) -> None:
+        if accumulate and self.dtype not in _DTYPE_CODES:
+            # same contract as the native path: accumulate needs a float
+            # payload (raw dtypes are opaque bytes)
+            raise TypeError(f"accumulate unsupported for dtype {self.dtype}")
+        a = _as_contiguous(array, self.dtype)
+        idx = self._mail_index(dst, slot)
+        off = self._locked(idx)
+        try:
+            mm = self._seg._mm
+            version, cur_p = struct.unpack_from("<Qd", mm, off)
+            if accumulate:
+                cur, _, _ = self._read_slot(off)
+                a = cur + a
+                p = cur_p + p
+            mm[off + self._HDR:off + self._HDR + self.nbytes] = a.tobytes()
+            struct.pack_into("<Qd", mm, off, version + 1, p)
+        finally:
+            self._unlock(idx)
+
+    def read(self, slot: int, collect: bool = False):
+        idx = self._mail_index(self.rank, slot)
+        off = self._locked(idx)
+        try:
+            a, p, version = self._read_slot(off)
+            if collect:
+                mm = self._seg._mm
+                mm[off + self._HDR:off + self._HDR + self.nbytes] = (
+                    b"\x00" * self.nbytes
+                )
+                struct.pack_into("<Qd", mm, off, version, 0.0)
+        finally:
+            self._unlock(idx)
+        return a, p, version
+
+    def read_version(self, slot: int) -> int:
+        idx = self._mail_index(self.rank, slot)
+        off = self._locked(idx)
+        try:
+            return int(struct.unpack_from("<Q", self._seg._mm, off)[0])
+        finally:
+            self._unlock(idx)
+
+    def reset(self, slot: int) -> None:
+        idx = self._mail_index(self.rank, slot)
+        off = self._locked(idx)
+        try:
+            mm = self._seg._mm
+            version = struct.unpack_from("<Q", mm, off)[0]
+            mm[off + self._HDR:off + self._HDR + self.nbytes] = (
+                b"\x00" * self.nbytes
+            )
+            struct.pack_into("<Qd", mm, off, version, 0.0)
+        finally:
+            self._unlock(idx)
+
+    def expose(self, array, p: float = 1.0) -> None:
+        a = _as_contiguous(array, self.dtype)
+        off = self._locked(self.rank)
+        try:
+            mm = self._seg._mm
+            version = struct.unpack_from("<Q", mm, off)[0]
+            mm[off + self._HDR:off + self._HDR + self.nbytes] = a.tobytes()
+            struct.pack_into("<Qd", mm, off, version + 1, p)
+        finally:
+            self._unlock(self.rank)
+
+    def read_exposed(self, src: int):
+        off = self._locked(src)
+        try:
+            return self._read_slot(off)
+        finally:
+            self._unlock(src)
+
+    def close(self, unlink: bool = False) -> None:
+        self._seg.close(unlink)
+
+
+# ---------------------------------------------------------------------------
+# factory
+# ---------------------------------------------------------------------------
+
+
+def make_job(job: str, rank: int, nranks: int):
+    """Native job segment when the .so is available, else the fallback."""
+    if get_lib() is not None and not _force_fallback():
+        return NativeShmJob(job, rank, nranks)
+    return FallbackShmJob(job, rank, nranks)
+
+
+def make_window(job: str, name: str, rank: int, nranks: int, maxd: int,
+                shape, dtype):
+    if get_lib() is not None and not _force_fallback():
+        return NativeShmWindow(job, name, rank, nranks, maxd, shape, dtype)
+    return FallbackShmWindow(job, name, rank, nranks, maxd, shape, dtype)
+
+
+def _force_fallback() -> bool:
+    return os.environ.get("BLUEFOG_SHM_FALLBACK", "0") == "1"
+
+
+def unlink_segment(job: str, suffix: str) -> None:
+    """Best-effort unlink of one named segment (native object + fallback
+    file); missing names are ignored."""
+    n = seg_name(job, suffix)
+    lib = get_lib()
+    if lib is not None:
+        try:
+            lib.bf_shm_unlink(n.encode())
+        except Exception:
+            pass
+    for d in {"/dev/shm", _FALLBACK_DIR}:
+        try:
+            os.unlink(os.path.join(d, n[1:]))
+        except OSError:
+            pass
+
+
+def unlink_all(job: str, window_names=()) -> None:
+    """Best-effort cleanup of ALL of a job's segments (crashed-run hygiene).
+
+    Globs ``/dev/shm`` (where shm_open objects appear as files on Linux) and
+    the fallback dir for the job prefix, so window segments are reclaimed
+    even when the caller no longer knows their names (a crashed run); the
+    explicit ``window_names`` are unlinked too for non-Linux portability.
+    """
+    import glob as _glob
+
+    lib = get_lib()
+    prefix = seg_name(job, "")  # "/bf_<job>_"
+    names = {seg_name(job, "job")}
+    names.update(seg_name(job, f"win_{n}") for n in window_names)
+    for d in {"/dev/shm", _FALLBACK_DIR}:
+        for path in _glob.glob(os.path.join(d, prefix[1:] + "*")):
+            names.add("/" + os.path.basename(path))
+    for n in names:
+        if lib is not None:
+            try:
+                lib.bf_shm_unlink(n.encode())
+            except Exception:
+                pass
+        for d in {"/dev/shm", _FALLBACK_DIR}:
+            try:
+                os.unlink(os.path.join(d, n[1:]))
+            except OSError:
+                pass

@@ -40,6 +40,7 @@ from bluefog_tpu import ops, ops_spmd, windows
 from bluefog_tpu.core import basics
 from bluefog_tpu.core.basics import LOCAL_AXIS, MACHINES_AXIS, NODES_AXIS
 from bluefog_tpu.core.plan import CommPlan
+from bluefog_tpu.timeline import timeline_context
 
 __all__ = [
     "CommunicationType",
@@ -307,7 +308,13 @@ class _EagerDistributedOptimizer:
                     out_specs=(spec, state_spec),
                 )
             )
-        return self._step_fns[key](params, grads, state)
+        # the whole fused step is one dispatch, so the step span is the
+        # BLUEFOG_TIMELINE signal here (per-op spans exist only on the
+        # eager op path)
+        with timeline_context(
+            f"optimizer_step_{self._mode}_{self.communication_type.name}"
+        ):
+            return self._step_fns[key](params, grads, state)
 
 
 class DistributedAdaptThenCombineOptimizer(_EagerDistributedOptimizer):

@@ -13,6 +13,9 @@ Usage:
   bftpu-run --simulate 8 python train.py       # 8 virtual CPU devices
   bftpu-run -np 4 --coordinator host:port --process-id K python train.py
                                                # explicit multi-host bootstrap
+  bftpu-run --islands 4 python async_train.py  # N async island processes
+                                               # (bluefog_tpu.islands jobs —
+                                               # the ``mpirun -np N`` shape)
 """
 
 from __future__ import annotations
@@ -75,6 +78,20 @@ def main(argv=None) -> int:
         metavar="N",
         help="run on N virtual CPU devices instead of TPU (testing)",
     )
+    parser.add_argument(
+        "--islands",
+        type=int,
+        default=0,
+        metavar="N",
+        help="spawn N asynchronous island processes (bluefog_tpu.islands): "
+        "each gets BLUEFOG_ISLAND_RANK/SIZE/JOB and steps independently — "
+        "the direct analogue of the reference's `bfrun -np N` process model",
+    )
+    parser.add_argument(
+        "--job",
+        default=None,
+        help="island job name (shared-memory namespace); default: pid-derived",
+    )
     parser.add_argument("--timeline", default=None, help="write a Chrome trace here")
     parser.add_argument("-v", "--verbose", action="store_true")
     parser.add_argument("command", nargs=argparse.REMAINDER, help="program to run")
@@ -86,11 +103,63 @@ def main(argv=None) -> int:
     if cmd and cmd[0] == "--":
         cmd = cmd[1:]
     env = build_env(args)
+    if args.islands:
+        return _run_islands(cmd, env, args.islands, args.job)
     try:
         os.execvpe(cmd[0], cmd, env)
     except FileNotFoundError:
         print(f"bftpu-run: command not found: {cmd[0]}", file=sys.stderr)
         return 127
+
+
+def _run_islands(cmd, env, nranks: int, job: str | None) -> int:
+    """Fork N child processes, one island each (the `mpirun -np N` shape of
+    the reference's launcher [U], minus ssh/NIC plumbing: islands on one
+    host talk through shared memory).  Returns the first nonzero child exit
+    code, and tears the others down on failure."""
+    import signal
+    import subprocess
+
+    job = job or f"bfrun{os.getpid()}"
+    procs = []
+    for r in range(nranks):
+        child_env = dict(env)
+        child_env["BLUEFOG_ISLAND_RANK"] = str(r)
+        child_env["BLUEFOG_ISLAND_SIZE"] = str(nranks)
+        child_env["BLUEFOG_ISLAND_JOB"] = job
+        procs.append(subprocess.Popen(cmd, env=child_env))
+    code = 0
+    try:
+        # poll ALL children: a rank can fail while its siblings are blocked
+        # in the shm barrier, so waiting in rank order would hang forever
+        import time as _time
+
+        live = list(procs)
+        while live:
+            for p in list(live):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                live.remove(p)
+                if rc != 0 and code == 0:
+                    code = rc
+                    for q in procs:
+                        if q.poll() is None:
+                            q.send_signal(signal.SIGTERM)
+            if live:
+                _time.sleep(0.05)
+    except KeyboardInterrupt:
+        for q in procs:
+            if q.poll() is None:
+                q.send_signal(signal.SIGINT)
+        code = 130
+    finally:
+        # reclaim the job's segments on EVERY path: a later run reusing the
+        # job name must never attach to stale mailboxes/barrier state
+        from bluefog_tpu.native import shm_native
+
+        shm_native.unlink_all(job)
+    return code
 
 
 if __name__ == "__main__":

@@ -32,6 +32,7 @@ from bluefog_tpu.optim import (
     gradient_allreduce_spmd,
     make_spmd_comm_fn,
 )
+from bluefog_tpu.timeline import timeline_context
 
 __all__ = ["make_decentralized_train_step", "replicate_for_mesh"]
 
@@ -163,7 +164,12 @@ def make_decentralized_train_step(
                 ),
                 donate_argnums=(0, 1, 2) if donate else (),
             )
-        return compiled[key](params, batch_stats, opt_state, batch, labels)
+        # step-level span: jitted training records no per-op host spans, so
+        # this is where BLUEFOG_TIMELINE traces come from (the reference's
+        # per-tensor spans are a background-thread artifact; dispatch of the
+        # whole fused step is the honest TPU equivalent)
+        with timeline_context("train_step"):
+            return compiled[key](params, batch_stats, opt_state, batch, labels)
 
     return init_fn, step_fn
 

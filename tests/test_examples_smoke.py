@@ -37,3 +37,17 @@ def test_example_runs_and_loss_finite(script, args):
     assert losses, proc.stdout
     assert all(l == l and l < 100 for l in losses)  # finite, sane
     assert "done:" in proc.stdout
+
+
+def test_async_islands_example():
+    """The asynchronous-islands demo (true multi-process one-sided ops):
+    exact async consensus + gossip SGD agreement across 4 island
+    processes."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples/jax_async_islands.py"),
+         "--iters", "40", "--sleep", "0.001"],
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "async islands demo OK" in proc.stdout, proc.stdout
