@@ -148,6 +148,13 @@ def init(rank_: Optional[int] = None, size_: Optional[int] = None,
     global _context
     if _context is not None:
         return
+    if rank_ is None or size_ is None:
+        if "BLUEFOG_ISLAND_RANK" not in os.environ:
+            raise RuntimeError(
+                "islands.init() needs rank/size: either pass them explicitly "
+                "or launch under `bftpu-run --islands N` (which sets "
+                "BLUEFOG_ISLAND_RANK/SIZE/JOB), or use islands.spawn()"
+            )
     r = int(os.environ["BLUEFOG_ISLAND_RANK"]) if rank_ is None else int(rank_)
     n = int(os.environ["BLUEFOG_ISLAND_SIZE"]) if size_ is None else int(size_)
     j = os.environ.get("BLUEFOG_ISLAND_JOB", "default") if job is None else job
@@ -228,6 +235,22 @@ def _win(name: str) -> _IslandWindow:
     return w
 
 
+def _check_dst(win: _IslandWindow, dst_weights: WeightDict):
+    """Destination ranks for a put/accumulate, validated against MY
+    out-neighbors (a deposit lands in the slot keyed by the WRITER, so a
+    non-out-neighbor target has no slot for us — fail with the real reason
+    rather than a confusing slot KeyError)."""
+    if dst_weights is None:
+        return win.out_neighbors
+    unknown = set(dst_weights) - set(win.out_neighbors)
+    if unknown:
+        raise KeyError(
+            f"dst_weights for non-out-neighbor rank(s) {sorted(unknown)}; "
+            f"out-neighbors of rank {_ctx().rank} are {win.out_neighbors}"
+        )
+    return dst_weights
+
+
 def _to_host(tensor) -> np.ndarray:
     # jax.Array, torch.Tensor (cpu), or array-like → host numpy
     if hasattr(tensor, "detach"):
@@ -281,10 +304,11 @@ def win_put(tensor, name: str, dst_weights: WeightDict = None) -> bool:
         t = _to_host(tensor).astype(win.shm.dtype, copy=False)
         win.self_tensor = np.array(t, copy=True)
         win.shm.expose(win.self_tensor, win.p_self)
-        targets = win.out_neighbors if dst_weights is None else dst_weights
+        targets = _check_dst(win, dst_weights)
         for d in targets:
             wgt = 1.0 if dst_weights is None else float(dst_weights[d])
-            win.shm.write(d, win.slot_of[d][ctx.rank], t * wgt,
+            payload = t if wgt == 1.0 else t * wgt
+            win.shm.write(d, win.slot_of[d][ctx.rank], payload,
                           p=win.p_self * wgt, accumulate=False)
     return True
 
@@ -298,10 +322,11 @@ def win_accumulate(tensor, name: str, dst_weights: WeightDict = None) -> bool:
         ctx = _ctx()
         win = _win(name)
         t = _to_host(tensor).astype(win.shm.dtype, copy=False)
-        targets = win.out_neighbors if dst_weights is None else dst_weights
+        targets = _check_dst(win, dst_weights)
         for d in targets:
             wgt = 1.0 if dst_weights is None else float(dst_weights[d])
-            win.shm.write(d, win.slot_of[d][ctx.rank], t * wgt,
+            payload = t if wgt == 1.0 else t * wgt
+            win.shm.write(d, win.slot_of[d][ctx.rank], payload,
                           p=win.p_self * wgt, accumulate=True)
     return True
 
@@ -313,6 +338,13 @@ def win_get(name: str, src_weights: WeightDict = None) -> bool:
     with timeline_context("island_win_get"):
         ctx = _ctx()
         win = _win(name)
+        if src_weights is not None:
+            unknown = set(src_weights) - set(win.in_neighbors)
+            if unknown:
+                raise KeyError(
+                    f"src_weights for non-in-neighbor rank(s) {sorted(unknown)}; "
+                    f"in-neighbors of rank {ctx.rank} are {win.in_neighbors}"
+                )
         sources = win.in_neighbors if src_weights is None else src_weights
         for s in sources:
             wgt = 1.0 if src_weights is None else float(src_weights[s])

@@ -365,7 +365,13 @@ class FallbackShmWindow:
 
 
 def make_job(job: str, rank: int, nranks: int):
-    """Native job segment when the .so is available, else the fallback."""
+    """Transport factory: TCP (cross-host / DCN) when configured, else the
+    native shm mailbox, else the lockf fallback."""
+    coord = _tcp_coord(job)
+    if coord is not None:
+        from bluefog_tpu.native.tcp_transport import TcpShmJob
+
+        return TcpShmJob(job, rank, nranks, coord)
     if get_lib() is not None and not _force_fallback():
         return NativeShmJob(job, rank, nranks)
     return FallbackShmJob(job, rank, nranks)
@@ -373,6 +379,11 @@ def make_job(job: str, rank: int, nranks: int):
 
 def make_window(job: str, name: str, rank: int, nranks: int, maxd: int,
                 shape, dtype):
+    coord = _tcp_coord(job)
+    if coord is not None:
+        from bluefog_tpu.native.tcp_transport import TcpShmWindow
+
+        return TcpShmWindow(job, name, rank, nranks, maxd, shape, dtype, coord)
     if get_lib() is not None and not _force_fallback():
         return NativeShmWindow(job, name, rank, nranks, maxd, shape, dtype)
     return FallbackShmWindow(job, name, rank, nranks, maxd, shape, dtype)
@@ -380,6 +391,24 @@ def make_window(job: str, name: str, rank: int, nranks: int, maxd: int,
 
 def _force_fallback() -> bool:
     return os.environ.get("BLUEFOG_SHM_FALLBACK", "0") == "1"
+
+
+def _tcp_coord(job: str) -> Optional[str]:
+    """Coordinator address when the TCP (cross-host) transport is selected:
+    ``BLUEFOG_ISLAND_COORD=host:port`` selects it outright;
+    ``BLUEFOG_ISLAND_TRANSPORT=tcp`` derives a job-deterministic localhost
+    port (single-host testing)."""
+    coord = os.environ.get("BLUEFOG_ISLAND_COORD")
+    if coord:
+        return coord
+    if os.environ.get("BLUEFOG_ISLAND_TRANSPORT", "").lower() == "tcp":
+        import zlib
+
+        # below the Linux ephemeral range (32768+): a transient client
+        # socket must never occupy the derived coordinator port
+        port = 10000 + zlib.crc32(job.encode()) % 20000
+        return f"127.0.0.1:{port}"
+    return None
 
 
 def unlink_segment(job: str, suffix: str) -> None:

@@ -1,0 +1,448 @@
+"""TCP mailbox transport — the islands' cross-host (DCN) path.
+
+Same window model and interface as the shared-memory transport
+(:mod:`bluefog_tpu.native.shm_native`), carried over sockets so island
+processes can live on DIFFERENT hosts: the deployment where each TPU pod
+host runs one island and gossips parameters asynchronously over the
+data-center network, exactly the role the reference's CUDA-aware MPI RMA
+plays between its GPU nodes (``MPI_Win_create``/``MPI_Put`` over
+IB/Ethernet, ``bluefog/common/mpi_controller.cc`` [U]; SURVEY.md §2.4).
+
+Topology of responsibility (the passive-target model, unchanged):
+
+- every rank runs a small **mailbox server thread** that OWNS that rank's
+  state: its mail slots (one per in-neighbor per window), its exposed
+  tensor, its mutex, and — on rank 0 — the job barrier;
+- ``write``/``read_exposed`` are requests to the *destination's* server —
+  the receiver's application code never participates (one-sided);
+- ``read``/``collect``/``expose``/``reset`` touch only the local server's
+  store (an in-process dict guarded by a lock) — no network;
+- rendezvous: rank 0 additionally serves a registry where every rank posts
+  its ``host:port`` and fetches the full table, so only ONE address
+  (``BLUEFOG_ISLAND_COORD``) must be known up front — the analogue of
+  ``bfrun``'s host list [U].
+
+Wire format: 32-byte fixed header ``(op, win_id, slot, mode, nbytes, p)``
++ raw payload bytes, over persistent connections (one per peer, created
+lazily).  No external dependencies.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+# ops
+_OP_WRITE = 1          # deposit into (my) mail slot: mode 0 put, 1 accumulate
+_OP_READ_EXPOSED = 2   # return my exposed tensor
+_OP_MUTEX_ACQ = 3
+_OP_MUTEX_REL = 4
+_OP_BARRIER = 5        # rank-0 only
+_OP_REGISTER = 6       # rank-0 only: register rank -> addr, get table when full
+_OP_PING = 7
+
+_HDR = struct.Struct("<iiiiqd")  # op, win_id, slot, mode, nbytes, p
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _send_msg(sock, op, win_id=0, slot=0, mode=0, p=0.0, payload=b""):
+    sock.sendall(_HDR.pack(op, win_id, slot, mode, len(payload), p) + payload)
+
+
+def _recv_msg(sock):
+    op, win_id, slot, mode, nbytes, p = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    payload = _recv_exact(sock, nbytes) if nbytes else b""
+    return op, win_id, slot, mode, p, payload
+
+
+class _Slot:
+    __slots__ = ("data", "p", "version")
+
+    def __init__(self, nbytes: int):
+        self.data = bytearray(nbytes)
+        self.p = 0.0
+        self.version = 0
+
+
+class _WinStore:
+    """One window's rank-local state, owned by the server thread."""
+
+    def __init__(self, maxd: int, nbytes: int, dtype):
+        self.nbytes = nbytes
+        self.dtype = np.dtype(dtype)
+        self.mail = [_Slot(nbytes) for _ in range(max(maxd, 1))]
+        self.exposed = _Slot(nbytes)
+
+
+class _Server:
+    """Per-rank mailbox server: owns this rank's slots/exposed/mutex (and
+    the barrier + registry on rank 0).  Thread-per-connection; handlers are
+    short critical sections under one lock (mutex/barrier waits use
+    conditions so they never hold it)."""
+
+    def __init__(self, rank: int, nranks: int, host: str, port: int = 0):
+        self.rank = rank
+        self.nranks = nranks
+        self.lock = threading.Lock()
+        self.windows: Dict[int, _WinStore] = {}
+        # mutex (this rank's): the CONNECTION holding it, or None — owner
+        # tracking lets a dead holder's disconnect release the lock
+        self.mutex_cond = threading.Condition()
+        self.mutex_owner = None
+        # barrier state (rank 0 only)
+        self.bar_cond = threading.Condition()
+        self.bar_count = 0
+        self.bar_gen = 0
+        # registry (rank 0 only)
+        self.reg_cond = threading.Condition()
+        self.registry: Dict[int, str] = {}
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind((host, port))
+        self.sock.listen(nranks * 4 + 8)
+        self.port = self.sock.getsockname()[1]
+        self._stop = False
+        self.thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self.thread.start()
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn):
+        try:
+            while True:
+                op, win_id, slot, mode, p, payload = _recv_msg(conn)
+                if op == _OP_WRITE:
+                    with self.lock:
+                        w = self.windows[win_id]
+                        s = w.mail[slot]
+                        if mode == 1 and w.dtype.kind == "f":
+                            a = np.frombuffer(bytes(s.data), w.dtype) + \
+                                np.frombuffer(payload, w.dtype)
+                            s.data[:] = a.tobytes()
+                            s.p += p
+                        else:
+                            s.data[:] = payload
+                            s.p = p
+                        s.version += 1
+                    _send_msg(conn, op)  # ack → MPI_Win_flush semantics
+                elif op == _OP_READ_EXPOSED:
+                    with self.lock:
+                        w = self.windows[win_id]
+                        s = w.exposed
+                        data, pv = bytes(s.data), s.p
+                        ver = s.version
+                    _send_msg(conn, op, win_id, ver, 0, pv, data)
+                elif op == _OP_MUTEX_ACQ:
+                    with self.mutex_cond:
+                        while self.mutex_owner is not None:
+                            self.mutex_cond.wait()
+                        self.mutex_owner = conn
+                    _send_msg(conn, op)
+                elif op == _OP_MUTEX_REL:
+                    with self.mutex_cond:
+                        if self.mutex_owner is conn:
+                            self.mutex_owner = None
+                            self.mutex_cond.notify()
+                    _send_msg(conn, op)
+                elif op == _OP_BARRIER:
+                    with self.bar_cond:
+                        gen = self.bar_gen
+                        self.bar_count += 1
+                        if self.bar_count == self.nranks:
+                            self.bar_count = 0
+                            self.bar_gen += 1
+                            self.bar_cond.notify_all()
+                        else:
+                            while self.bar_gen == gen:
+                                self.bar_cond.wait()
+                    _send_msg(conn, op)
+                elif op == _OP_REGISTER:
+                    r = slot
+                    addr = payload.decode()
+                    with self.reg_cond:
+                        self.registry[r] = addr
+                        if len(self.registry) == self.nranks:
+                            self.reg_cond.notify_all()
+                        else:
+                            while len(self.registry) < self.nranks:
+                                self.reg_cond.wait()
+                        table = "\n".join(
+                            f"{k} {v}" for k, v in sorted(self.registry.items())
+                        ).encode()
+                    _send_msg(conn, op, payload=table)
+                elif op == _OP_PING:
+                    _send_msg(conn, op)
+                else:
+                    raise ValueError(f"bad op {op}")
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            # a dying holder must not leave the mutex locked forever
+            with self.mutex_cond:
+                if self.mutex_owner is conn:
+                    self.mutex_owner = None
+                    self.mutex_cond.notify()
+            conn.close()
+
+    def stop(self):
+        self._stop = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class _Peers:
+    """Lazy persistent client connections, one per destination rank.
+    One request/response at a time per peer (guarded by a lock) — the
+    caller is single-threaded in practice, the lock makes it safe anyway."""
+
+    def __init__(self, table: Dict[int, str]):
+        self.table = table
+        self.conns: Dict[int, socket.socket] = {}
+        self.locks: Dict[int, threading.Lock] = {}
+
+    def request(self, rank: int, op, win_id=0, slot=0, mode=0, p=0.0,
+                payload=b""):
+        lock = self.locks.setdefault(rank, threading.Lock())
+        with lock:
+            conn = self.conns.get(rank)
+            if conn is None:
+                host, port = self.table[rank].rsplit(":", 1)
+                conn = socket.create_connection((host, int(port)), timeout=60)
+                # the setup timeout must NOT persist: mutex/barrier waits
+                # legitimately block for arbitrary lengths
+                conn.settimeout(None)
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self.conns[rank] = conn
+            _send_msg(conn, op, win_id, slot, mode, p, payload)
+            return _recv_msg(conn)
+
+    def close(self):
+        for c in self.conns.values():
+            try:
+                c.close()
+            except OSError:
+                pass
+        self.conns.clear()
+
+
+class _JobRuntime:
+    """Shared per-process runtime: server + peer table (created once, used
+    by the job handle and every window)."""
+
+    _by_key: Dict[Tuple[str, int], "_JobRuntime"] = {}
+    _cls_lock = threading.Lock()
+
+    def __init__(self, job: str, rank: int, nranks: int, coord: str):
+        self.job = job
+        self.rank = rank
+        self.nranks = nranks
+        host = os.environ.get("BLUEFOG_ISLAND_HOST", "127.0.0.1")
+        self.server = _Server(rank, nranks, host)
+        self._win_ids: Dict[str, int] = {}
+        self._next_win = 0
+        chost, cport = coord.rsplit(":", 1)
+        if rank == 0:
+            # rank 0 additionally runs the coordinator (rendezvous +
+            # barrier) on the well-known port
+            self._coord_server = _Server(rank, nranks, chost, int(cport))
+        else:
+            self._coord_server = None
+        # register with the coordinator (retry while rank 0 comes up)
+        my_addr = f"{host}:{self.server.port}"
+        deadline = time.time() + 60
+        while True:
+            try:
+                coord_conn = socket.create_connection(
+                    (chost, int(cport)), timeout=5
+                )
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.05)
+        # registration/barrier replies wait on OTHER ranks — no timeout
+        coord_conn.settimeout(None)
+        coord_conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _send_msg(coord_conn, _OP_REGISTER, slot=rank, payload=my_addr.encode())
+        _, _, _, _, _, table_raw = _recv_msg(coord_conn)
+        self._coord_conn = coord_conn  # kept open: barrier rides on it
+        table = {}
+        for line in table_raw.decode().splitlines():
+            k, v = line.split()
+            table[int(k)] = v
+        self.peers = _Peers(table)
+
+    @classmethod
+    def get(cls, job: str, rank: int, nranks: int, coord: str) -> "_JobRuntime":
+        with cls._cls_lock:
+            key = (job, rank)
+            rt = cls._by_key.get(key)
+            if rt is None:
+                rt = cls(job, rank, nranks, coord)
+                cls._by_key[key] = rt
+            return rt
+
+    @classmethod
+    def drop(cls, job: str, rank: int):
+        with cls._cls_lock:
+            rt = cls._by_key.pop((job, rank), None)
+        if rt is not None:
+            rt.peers.close()
+            try:
+                rt._coord_conn.close()
+            except OSError:
+                pass
+            rt.server.stop()
+            if rt._coord_server is not None:
+                rt._coord_server.stop()
+
+    def win_id(self, name: str) -> int:
+        # window ids must agree across ranks: windows are created
+        # collectively in the same order (enforced by the create barrier),
+        # so a per-process counter stays in sync
+        if name not in self._win_ids:
+            self._win_ids[name] = self._next_win
+            self._next_win += 1
+        return self._win_ids[name]
+
+    def barrier(self):
+        with self.peers.locks.setdefault(-1, threading.Lock()):
+            _send_msg(self._coord_conn, _OP_BARRIER)
+            _recv_msg(self._coord_conn)
+
+
+class TcpShmJob:
+    """Job handle with the shm-job interface (barrier + mutexes)."""
+
+    def __init__(self, job: str, rank: int, nranks: int, coord: str):
+        self.rt = _JobRuntime.get(job, rank, nranks, coord)
+        self.job = job
+        self.rank = rank
+
+    def barrier(self) -> None:
+        self.rt.barrier()
+
+    def mutex_acquire(self, rank: int) -> None:
+        self.rt.peers.request(rank, _OP_MUTEX_ACQ)
+
+    def mutex_release(self, rank: int) -> None:
+        self.rt.peers.request(rank, _OP_MUTEX_REL)
+
+    def close(self, unlink: bool = False) -> None:
+        del unlink
+        _JobRuntime.drop(self.job, self.rank)
+
+
+class TcpShmWindow:
+    """Window handle with the shm-window interface over the TCP runtime."""
+
+    def __init__(self, job: str, name: str, rank: int, nranks: int,
+                 maxd: int, shape, dtype, coord: str):
+        self.rt = _JobRuntime.get(job, rank, nranks, coord)
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self.nbytes = int(np.prod(self.shape, dtype=np.int64)) * self.dtype.itemsize
+        self._id = self.rt.win_id(name)
+        with self.rt.server.lock:
+            self.rt.server.windows[self._id] = _WinStore(
+                maxd, self.nbytes, self.dtype
+            )
+
+    # -- local (owner-side) ops --------------------------------------------
+    def _store(self) -> _WinStore:
+        return self.rt.server.windows[self._id]
+
+    def read(self, slot: int, collect: bool = False):
+        with self.rt.server.lock:
+            s = self._store().mail[slot]
+            a = np.frombuffer(bytes(s.data), self.dtype).reshape(self.shape)
+            p, ver = s.p, s.version
+            if collect:
+                s.data[:] = b"\x00" * self.nbytes
+                s.p = 0.0
+        return a.copy(), p, ver
+
+    def read_version(self, slot: int) -> int:
+        with self.rt.server.lock:
+            return self._store().mail[slot].version
+
+    def reset(self, slot: int) -> None:
+        with self.rt.server.lock:
+            s = self._store().mail[slot]
+            s.data[:] = b"\x00" * self.nbytes
+            s.p = 0.0
+
+    def expose(self, array, p: float = 1.0) -> None:
+        a = np.ascontiguousarray(np.asarray(array, self.dtype))
+        with self.rt.server.lock:
+            s = self._store().exposed
+            s.data[:] = a.tobytes()
+            s.p = float(p)
+            s.version += 1
+
+    # -- remote (one-sided) ops --------------------------------------------
+    def write(self, dst: int, slot: int, array, p: float = 1.0,
+              accumulate: bool = False) -> None:
+        if accumulate and self.dtype.kind != "f":
+            raise TypeError(f"accumulate unsupported for dtype {self.dtype}")
+        a = np.ascontiguousarray(np.asarray(array, self.dtype))
+        if dst == self.rt.rank:
+            # local fast path, same semantics
+            with self.rt.server.lock:
+                s = self._store().mail[slot]
+                if accumulate:
+                    cur = np.frombuffer(bytes(s.data), self.dtype)
+                    s.data[:] = (cur + a.ravel()).tobytes()
+                    s.p += float(p)
+                else:
+                    s.data[:] = a.tobytes()
+                    s.p = float(p)
+                s.version += 1
+            return
+        self.rt.peers.request(
+            dst, _OP_WRITE, self._id, slot, 1 if accumulate else 0,
+            float(p), a.tobytes(),
+        )
+
+    def read_exposed(self, src: int):
+        if src == self.rt.rank:
+            with self.rt.server.lock:
+                s = self._store().exposed
+                a = np.frombuffer(bytes(s.data), self.dtype).reshape(self.shape)
+                return a.copy(), s.p, s.version
+        _, _, ver, _, p, payload = self.rt.peers.request(
+            src, _OP_READ_EXPOSED, self._id
+        )
+        a = np.frombuffer(payload, self.dtype).reshape(self.shape)
+        return a.copy(), p, ver
+
+    def close(self, unlink: bool = False) -> None:
+        del unlink
+        with self.rt.server.lock:
+            self.rt.server.windows.pop(self._id, None)
