@@ -68,6 +68,7 @@ __all__ = [
     "win_associated_p",
     "win_set_exposed",
     "push_sum_round",
+    "DistributedWinPutOptimizer",
     "get_win_version",
     "turn_on_win_ops_with_associated_p",
     "turn_off_win_ops_with_associated_p",
@@ -513,6 +514,123 @@ def turn_on_win_ops_with_associated_p() -> None:
 
 def turn_off_win_ops_with_associated_p() -> None:
     _ctx().associated_p = False
+
+
+# ---------------------------------------------------------------------------
+# asynchronous WinPut optimizer (the reference's flagship async training API)
+# ---------------------------------------------------------------------------
+
+
+class DistributedWinPutOptimizer:
+    """Asynchronous decentralized optimizer over island windows — the
+    reference's ``bf.DistributedWinPutOptimizer`` [U] with TRUE async
+    semantics: after each local update the parameters are deposited into
+    out-neighbors' windows (one-sided) and combined with whatever the
+    in-neighbors have deposited so far — no barrier, ranks step at their
+    own pace (SURVEY.md §3.4, §2.3 "Asynchronous decentralized DP").
+
+    Wraps any optax ``GradientTransformation``.  Leaves are packed into one
+    window per dtype (the reference's tensor-fusion idea: two window ops
+    per step instead of two per leaf).  ``num_steps_per_communication``
+    mirrors the reference's local-SGD cadence knob.
+
+    Usage (inside an island process)::
+
+        opt = islands.DistributedWinPutOptimizer(optax.sgd(0.1))
+        state = opt.init(params)          # collective: creates the windows
+        params, state = opt.step(params, grads, state)   # async gossip
+    """
+
+    def __init__(self, base_optimizer, window_prefix: str = "island_winput",
+                 num_steps_per_communication: int = 1):
+        import optax  # local import: islands itself is numpy-only otherwise
+
+        del optax
+        self.base = base_optimizer
+        self.prefix = window_prefix
+        self.k = int(num_steps_per_communication)
+        self._step_count = 0
+        self._groups = None  # [(leaf_indices, shapes, sizes, np_dtype)]
+
+    def _pack(self, flat, idxs, dtype):
+        return np.concatenate(
+            [np.asarray(flat[i], dtype=dtype).ravel() for i in idxs]
+        ) if idxs else np.zeros((0,), dtype)
+
+    def init(self, params):
+        import jax
+
+        flat, _ = jax.tree_util.tree_flatten(params)
+        by_dtype: Dict = {}
+        for i, leaf in enumerate(flat):
+            by_dtype.setdefault(np.asarray(leaf).dtype, []).append(i)
+        self._groups = []
+        for g, (dt, idxs) in enumerate(
+            sorted(by_dtype.items(), key=lambda kv: str(kv[0]))
+        ):
+            shapes = [tuple(np.shape(flat[i])) for i in idxs]
+            sizes = [int(np.prod(s, dtype=np.int64)) for s in shapes]
+            packed = self._pack(flat, idxs, dt)
+            win_create(packed, f"{self.prefix}.{g}")
+            self._groups.append((idxs, shapes, sizes, dt))
+        return self.base.init(params)
+
+    def _unpack_into(self, flat, combined, idxs, shapes, sizes):
+        """Scatter a combined window buffer back into the leaves, keeping
+        each leaf's container kind (numpy vs jax) and EXACT dtype — a bare
+        jnp.asarray would silently drop x64."""
+        import jax.numpy as jnp
+
+        off = 0
+        for i, shape, size in zip(idxs, shapes, sizes):
+            arr = combined[off:off + size].reshape(shape)
+            leaf = flat[i]
+            if isinstance(leaf, np.ndarray):
+                flat[i] = arr.astype(leaf.dtype, copy=False)
+            else:
+                flat[i] = jnp.asarray(arr, dtype=leaf.dtype)
+            off += size
+
+    def step(self, params, grads, state):
+        import jax
+        import optax
+
+        updates, state = self.base.update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+        self._step_count += 1
+        if self._step_count % self.k != 0:
+            return params, state
+        flat, treedef = jax.tree_util.tree_flatten(params)
+        for g, (idxs, shapes, sizes, dt) in enumerate(self._groups):
+            name = f"{self.prefix}.{g}"
+            win_put(self._pack(flat, idxs, dt), name)
+            combined = win_update(name)
+            self._unpack_into(flat, combined, idxs, shapes, sizes)
+        return jax.tree_util.tree_unflatten(treedef, flat), state
+
+    def settle(self, params, rounds: int = 1):
+        """Barriered pure-gossip rounds: deposit, barrier, combine, barrier
+        — every combine sees THIS round's deposits from all neighbors, so
+        stragglers align deterministically.  Call after the async training
+        loop (all ranks, same ``rounds``); returns the combined params."""
+        import jax
+
+        for _ in range(rounds):
+            flat, treedef = jax.tree_util.tree_flatten(params)
+            for g, (idxs, _, _, dt) in enumerate(self._groups):
+                win_put(self._pack(flat, idxs, dt), f"{self.prefix}.{g}")
+            barrier()
+            for g, (idxs, shapes, sizes, _) in enumerate(self._groups):
+                combined = win_update(f"{self.prefix}.{g}")
+                self._unpack_into(flat, combined, idxs, shapes, sizes)
+            barrier()
+            params = jax.tree_util.tree_unflatten(treedef, flat)
+        return params
+
+    def free(self):
+        """Collective: release the optimizer's windows."""
+        for g in range(len(self._groups or [])):
+            win_free(f"{self.prefix}.{g}")
 
 
 # ---------------------------------------------------------------------------

@@ -1,4 +1,7 @@
 from bluefog_tpu.models.lenet import LeNet5
 from bluefog_tpu.models.resnet import ResNet, ResNet18, ResNet50
+from bluefog_tpu.models.vit import ViT, ViT_S16, ViT_B16
 
-__all__ = ["LeNet5", "ResNet", "ResNet18", "ResNet50"]
+__all__ = [
+    "LeNet5", "ResNet", "ResNet18", "ResNet50", "ViT", "ViT_S16", "ViT_B16",
+]

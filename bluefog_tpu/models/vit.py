@@ -1,0 +1,78 @@
+"""Vision Transformer for decentralized image classification.
+
+Model-family breadth beyond the reference (which ships only the CNNs of
+its examples — LeNet in ``examples/pytorch_mnist.py``, ResNets in
+``examples/pytorch_benchmark.py``/``pytorch_cifar10_resnet.py`` [U]): a
+standard ViT-B/16-style classifier that drops into the same decentralized
+train step (``training.make_decentralized_train_step``) and benchmark
+harness as the ResNets.  TPU-first choices: bf16 compute with fp32
+LayerNorm/softmax/head (MXU-friendly, numerically safe), patchify as a
+single strided conv (one big MXU matmul), static shapes throughout.
+
+Reuses the BERT encoder block (``transformer._EncoderBlock``) so the
+attention math lives in one place.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from bluefog_tpu.models.transformer import _EncoderBlock
+
+__all__ = ["ViT", "ViT_S16", "ViT_B16"]
+
+
+class ViT(nn.Module):
+    """Vision Transformer classifier ([CLS]-token pooling)."""
+
+    num_classes: int = 1000
+    patch_size: int = 16
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    dff: int = 3072
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, images, train: bool = False):
+        del train  # no dropout/batch-stats: keeps the step signature shared
+        B = images.shape[0]
+        # patchify = one strided conv: [B, H/P, W/P, hidden]
+        x = nn.Conv(
+            self.hidden_size,
+            kernel_size=(self.patch_size, self.patch_size),
+            strides=(self.patch_size, self.patch_size),
+            padding="VALID",
+            dtype=self.dtype,
+            name="patch_embed",
+        )(images)
+        x = x.reshape(B, -1, self.hidden_size)
+        cls = self.param(
+            "cls", nn.initializers.zeros, (1, 1, self.hidden_size)
+        )
+        x = jnp.concatenate([jnp.broadcast_to(cls, (B, 1, self.hidden_size))
+                             .astype(self.dtype), x], axis=1)
+        pos = self.param(
+            "pos_embedding",
+            nn.initializers.normal(0.02),
+            (1, x.shape[1], self.hidden_size),
+        )
+        x = x + pos.astype(self.dtype)
+        for _ in range(self.num_layers):
+            x = _EncoderBlock(self.num_heads, self.dff, self.dtype)(x, None)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x[:, 0])
+
+
+def ViT_S16(num_classes: int = 1000, **kw) -> ViT:
+    """ViT-Small/16 (22M params)."""
+    return ViT(num_classes=num_classes, hidden_size=384, num_layers=12,
+               num_heads=6, dff=1536, **kw)
+
+
+def ViT_B16(num_classes: int = 1000, **kw) -> ViT:
+    """ViT-Base/16 (86M params)."""
+    return ViT(num_classes=num_classes, **kw)

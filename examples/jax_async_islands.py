@@ -66,44 +66,38 @@ def worker(rank: int, size: int, iters: int, seed_sleep: float):
     islands.win_free("consensus")
     islands.turn_off_win_ops_with_associated_p()
 
-    # --- phase 2: asynchronous gossip SGD ----------------------------------
+    # --- phase 2: asynchronous gossip SGD via the WinPut optimizer ---------
+    import optax
+
     X_full, y_full, X, y = make_shard(rank, size)
     dim = X.shape[1]
 
-    @jax.jit
-    def grad_step(w, lr):
-        def loss(w):
-            z = jnp.asarray(X) @ w
-            return jnp.mean(
-                jnp.logaddexp(0.0, z) - jnp.asarray(y) * z
-            ) + 1e-3 * jnp.sum(w * w)
+    def local_loss(w):
+        z = jnp.asarray(X) @ w
+        return jnp.mean(
+            jnp.logaddexp(0.0, z) - jnp.asarray(y) * z
+        ) + 1e-3 * jnp.sum(w * w)
 
-        g = jax.grad(loss)(w)
-        return w - lr * g
-
+    grad_fn = jax.jit(jax.grad(local_loss))
     w = jnp.zeros((dim,), jnp.float32)
-    islands.win_create(np.asarray(w), "params")
-    gossip_every = 4
-    for it in range(iters):
-        w = grad_step(w, 0.5)
-        if (it + 1) % gossip_every == 0:
-            # win-put-optimizer pattern: deposit, combine, keep going — the
-            # neighbors read whatever is freshest; nobody waits
-            islands.win_put(np.asarray(w), "params")
-            w = jnp.asarray(islands.win_update("params"))
+    # the reference's async flagship: local adapt, then one-sided deposit +
+    # combine — nobody waits for anybody
+    opt = islands.DistributedWinPutOptimizer(
+        optax.sgd(0.5), num_steps_per_communication=4
+    )
+    state = opt.init(w)
+    for _ in range(iters):
+        w, state = opt.step(w, grad_fn(w), state)
         time.sleep(float(rng.random()) * seed_sleep)
-    # settle: a few more barriered gossip rounds align stragglers
+    # settle: barriered pure-gossip rounds align stragglers (deposit,
+    # barrier, combine, barrier — every combine sees fresh deposits)
     islands.barrier()
-    for _ in range(8):
-        islands.win_put(np.asarray(w), "params")
-        islands.barrier()
-        w = jnp.asarray(islands.win_update("params"))
-        islands.barrier()
+    w = opt.settle(w, rounds=8)
 
     z = X_full @ np.asarray(w)
     full_loss = float(np.mean(np.logaddexp(0.0, z) - y_full * z))
     acc = float((((z > 0).astype(np.float64)) == y_full).mean())
-    islands.win_free("params")
+    opt.free()
     return err1, full_loss, acc
 
 

@@ -385,3 +385,41 @@ def test_island_tcp_transport_mutex(monkeypatch, tmp_path):
     assert len(lines) == 2 * 2 * 40
     for i in range(0, len(lines), 2):
         assert lines[i].split()[0] == lines[i + 1].split()[0]
+
+
+def _worker_winput_opt(rank, size, steps):
+    """Async WinPut optimizer on per-rank quadratics: local loss
+    0.5*(w - c_r)^2 with c_r = rank; decentralized SGD + gossip pulls every
+    rank toward the global optimum mean(c) = (size-1)/2."""
+    import jax.numpy as jnp
+    import optax
+
+    islands.set_topology(topology_util.ExponentialTwoGraph(size))
+    c = float(rank)
+    params = {"w": jnp.full((3,), 10.0 + rank, jnp.float32),
+              "b": jnp.zeros((2,), jnp.float32)}
+    opt = islands.DistributedWinPutOptimizer(
+        optax.sgd(0.2), num_steps_per_communication=2
+    )
+    state = opt.init(params)
+    rng = np.random.default_rng(rank)
+    for _ in range(steps):
+        grads = {"w": params["w"] - c, "b": params["b"] * 0.0}
+        params, state = opt.step(params, grads, state)
+        time.sleep(float(rng.random()) * 0.001)
+    islands.barrier()
+    params = opt.settle(params, rounds=10)
+    opt.free()
+    return np.asarray(params["w"]).copy(), np.asarray(params["b"]).copy()
+
+
+def test_island_winput_optimizer_converges():
+    size, steps = 4, 80
+    res = islands.spawn(_worker_winput_opt, size, args=(steps,), timeout=240.0)
+    target = (size - 1) / 2.0  # mean of the per-rank optima
+    ws = np.stack([w for w, _ in res])
+    # every rank near the global optimum and near consensus
+    assert np.all(np.abs(ws - target) < 0.3), ws
+    assert ws.std(axis=0).max() < 0.05, ws
+    for _, b in res:
+        np.testing.assert_allclose(b, 0.0, atol=1e-6)

@@ -141,3 +141,40 @@ def test_models_forward_shapes():
     out = rn.apply(v, jnp.zeros((2, 16, 16, 3)), train=False)
     assert out.shape == (2, 7)
     assert out.dtype == jnp.float32
+
+
+def test_vit_forward_and_decentralized_step():
+    """ViT family: forward shape + a decentralized ATC train step on the
+    8-device mesh (shares the ResNet harness; no batch stats)."""
+    from bluefog_tpu.models import ViT
+
+    vit = ViT(num_classes=5, patch_size=4, hidden_size=32, num_layers=2,
+              num_heads=4, dff=64)
+    v = vit.init(jax.random.PRNGKey(0), jnp.zeros((2, 16, 16, 3)))
+    out = vit.apply(v, jnp.zeros((2, 16, 16, 3)))
+    assert out.shape == (2, 5)
+    assert out.dtype == jnp.float32
+
+    ctx = basics.context()
+    init_fn, step_fn = make_decentralized_train_step(
+        vit.apply, optax.sgd(0.05), ctx.mesh,
+        communication_type=CommunicationType.neighbor_allreduce,
+        plan=ctx.plan,
+        donate=False,
+    )
+    params = replicate_for_mesh(v["params"], SIZE)
+    opt_state = init_fn(params)
+    rng = np.random.default_rng(0)
+    batch = jnp.asarray(
+        rng.normal(size=(SIZE, 2, 16, 16, 3)).astype(np.float32)
+    )
+    labels = jnp.asarray(rng.integers(0, 5, size=(SIZE, 2)), jnp.int32)
+    bs = {}
+    losses = []
+    for _ in range(4):
+        params, bs, opt_state, loss, _ = step_fn(
+            params, bs, opt_state, batch, labels
+        )
+        losses.append(float(np.asarray(loss).mean()))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
