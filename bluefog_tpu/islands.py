@@ -107,8 +107,8 @@ class _IslandWindow:
         # windows._Window).
         self.shm.expose(self.self_tensor, self.p_self)
         if not zero_init:
-            for k in range(len(self.in_neighbors)):
-                self.shm.write(ctx.rank, k, tensor, p=1.0)
+            for k, s in enumerate(self.in_neighbors):
+                self.shm.write(ctx.rank, k, tensor, p=1.0, writer=s)
         ctx.shm_job.barrier()
 
 
@@ -167,17 +167,32 @@ def init(rank_: Optional[int] = None, size_: Optional[int] = None,
 
 def shutdown(unlink: bool = False) -> None:
     """Leave the job; ``unlink=True`` (call on exactly one rank, after a
-    barrier) removes the shm segments."""
+    barrier) removes the shm segments.
+
+    Hierarchical transport: shared memory is only reachable from its own
+    host, so each host group's leader additionally reclaims ITS host's
+    segments regardless of ``unlink`` — a global rank cannot clean a
+    remote /dev/shm.
+    """
     global _context
     if _context is None:
         return
-    for w in _context.windows.values():
+    ctx = _context
+    for w in ctx.windows.values():
         w.shm.close(unlink=False)
-    names = list(_context.created_names)
-    _context.windows.clear()
-    _context.shm_job.close(unlink=False)
+    names = list(ctx.created_names)
+    ctx.windows.clear()
+    ctx.shm_job.close(unlink=False)
+    hostmap = os.environ.get("BLUEFOG_ISLAND_HOSTMAP")
+    if hostmap:
+        from bluefog_tpu.native.routed_transport import parse_hostmap
+
+        hosts = parse_hostmap(hostmap, ctx.size)
+        local = [r for r in range(ctx.size) if hosts[r] == hosts[ctx.rank]]
+        if ctx.rank == local[0]:
+            shm_native.unlink_all(f"{ctx.job}_h{hosts[ctx.rank]}", names)
     if unlink:
-        shm_native.unlink_all(_context.job, names)
+        shm_native.unlink_all(ctx.job, names)
     _context = None
 
 
@@ -287,8 +302,9 @@ def win_free(name: Optional[str] = None) -> bool:
             continue
         w.shm.close(unlink=False)
         ctx.shm_job.barrier()  # all mappings closed
-        if ctx.rank == 0:
-            shm_native.unlink_segment(ctx.job, f"win_{n}")
+        # transport-aware designated unlink (plain shm: global rank 0;
+        # hierarchical: each host group's segment-rank-0; tcp: no-op)
+        w.shm.unlink_segments()
         ctx.shm_job.barrier()  # name gone everywhere before any re-create
         ctx.created_names.discard(n)
     return ok
@@ -350,8 +366,10 @@ def win_get(name: str, src_weights: WeightDict = None) -> bool:
         for s in sources:
             wgt = 1.0 if src_weights is None else float(src_weights[s])
             a, p, _ = win.shm.read_exposed(s)
+            # writer-of-record is s: deposit and later read must agree on
+            # which transport leg holds the slot (hierarchical routing)
             win.shm.write(ctx.rank, win.slot_of[ctx.rank][s], a * wgt,
-                          p=p * wgt, accumulate=False)
+                          p=p * wgt, accumulate=False, writer=s)
     return True
 
 
@@ -393,7 +411,9 @@ def win_update(
         acc = win.self_tensor.astype(wdt) * sw
         p_acc = sw * win.p_self
         for s in win.in_neighbors:
-            a, p, _ = win.shm.read(win.slot_of[ctx.rank][s], collect=reset)
+            a, p, _ = win.shm.read(
+                win.slot_of[ctx.rank][s], collect=reset, src=s
+            )
             acc = acc + nw[s] * a.astype(wdt)
             p_acc = p_acc + nw[s] * p
         win.self_tensor = acc.astype(win.shm.dtype)
@@ -470,7 +490,7 @@ def get_win_version(name: str) -> Dict[int, int]:
     ctx = _ctx()
     win = _win(name)
     return {
-        s: win.shm.read_version(win.slot_of[ctx.rank][s])
+        s: win.shm.read_version(win.slot_of[ctx.rank][s], src=s)
         for s in win.in_neighbors
     }
 

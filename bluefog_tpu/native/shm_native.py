@@ -90,6 +90,7 @@ class NativeShmWindow:
         if lib is None:
             raise RuntimeError("native library unavailable")
         self._lib = lib
+        self.rank = rank
         self.shape = tuple(shape)
         self.dtype = np.dtype(dtype)
         self.nbytes = int(np.prod(self.shape, dtype=np.int64)) * self.dtype.itemsize
@@ -103,7 +104,8 @@ class NativeShmWindow:
             raise RuntimeError(f"could not create shm window {self._name}")
 
     def write(self, dst: int, slot: int, array, p: float = 1.0,
-              accumulate: bool = False) -> None:
+              accumulate: bool = False, writer=None) -> None:
+        del writer  # single-transport: routing is the RoutedWindow's job
         if accumulate and self._code == 0:
             raise TypeError(f"accumulate unsupported for dtype {self.dtype}")
         a = _as_contiguous(array, self.dtype)
@@ -113,7 +115,8 @@ class NativeShmWindow:
             1 if accumulate else 0,
         )
 
-    def read(self, slot: int, collect: bool = False):
+    def read(self, slot: int, collect: bool = False, src=None):
+        del src
         out = np.empty(self.shape, dtype=self.dtype)
         p = ctypes.c_double(0.0)
         version = self._lib.bf_shm_win_read(
@@ -122,11 +125,13 @@ class NativeShmWindow:
         )
         return out, p.value, int(version)
 
-    def read_version(self, slot: int) -> int:
+    def read_version(self, slot: int, src=None) -> int:
+        del src
         # metadata-only probe: NULL out pointer skips the payload copy
         return int(self._lib.bf_shm_win_read(self._h, int(slot), None, None, 0))
 
-    def reset(self, slot: int) -> None:
+    def reset(self, slot: int, src=None) -> None:
+        del src
         self._lib.bf_shm_win_reset(self._h, int(slot))
 
     def expose(self, array, p: float = 1.0) -> None:
@@ -149,10 +154,31 @@ class NativeShmWindow:
             self._lib.bf_shm_win_destroy(self._h, 1 if unlink else 0)
             self._h = None
 
+    def unlink_segments(self) -> None:
+        """Name-based unlink by the designated (segment-rank-0) rank —
+        the collective win_free teardown (call after close, between
+        barriers)."""
+        if self.rank == 0:
+            _unlink_name(self._name)
+
     def __del__(self):
         try:
             self.close()
         except Exception:
+            pass
+
+
+def _unlink_name(name: str) -> None:
+    lib = get_lib()
+    if lib is not None:
+        try:
+            lib.bf_shm_unlink(name.encode())
+        except Exception:
+            pass
+    for d in {"/dev/shm", _FALLBACK_DIR}:
+        try:
+            os.unlink(os.path.join(d, name[1:]))
+        except OSError:
             pass
 
 
@@ -281,7 +307,8 @@ class FallbackShmWindow:
         self._seg.unlock(self._off(index), self._stride)
 
     def write(self, dst: int, slot: int, array, p: float = 1.0,
-              accumulate: bool = False) -> None:
+              accumulate: bool = False, writer=None) -> None:
+        del writer
         if accumulate and self.dtype not in _DTYPE_CODES:
             # same contract as the native path: accumulate needs a float
             # payload (raw dtypes are opaque bytes)
@@ -301,7 +328,8 @@ class FallbackShmWindow:
         finally:
             self._unlock(idx)
 
-    def read(self, slot: int, collect: bool = False):
+    def read(self, slot: int, collect: bool = False, src=None):
+        del src
         idx = self._mail_index(self.rank, slot)
         off = self._locked(idx)
         try:
@@ -316,7 +344,8 @@ class FallbackShmWindow:
             self._unlock(idx)
         return a, p, version
 
-    def read_version(self, slot: int) -> int:
+    def read_version(self, slot: int, src=None) -> int:
+        del src
         idx = self._mail_index(self.rank, slot)
         off = self._locked(idx)
         try:
@@ -324,7 +353,8 @@ class FallbackShmWindow:
         finally:
             self._unlock(idx)
 
-    def reset(self, slot: int) -> None:
+    def reset(self, slot: int, src=None) -> None:
+        del src
         idx = self._mail_index(self.rank, slot)
         off = self._locked(idx)
         try:
@@ -336,6 +366,13 @@ class FallbackShmWindow:
             struct.pack_into("<Qd", mm, off, version, 0.0)
         finally:
             self._unlock(idx)
+
+    def unlink_segments(self) -> None:
+        if self.rank == 0:
+            try:
+                os.unlink(self._seg.path)
+            except OSError:
+                pass
 
     def expose(self, array, p: float = 1.0) -> None:
         a = _as_contiguous(array, self.dtype)
@@ -364,33 +401,74 @@ class FallbackShmWindow:
 # ---------------------------------------------------------------------------
 
 
-def make_job(job: str, rank: int, nranks: int):
-    """Transport factory: TCP (cross-host / DCN) when configured, else the
-    native shm mailbox, else the lockf fallback."""
-    coord = _tcp_coord(job)
-    if coord is not None:
-        from bluefog_tpu.native.tcp_transport import TcpShmJob
-
-        return TcpShmJob(job, rank, nranks, coord)
+def make_shm_job(job: str, rank: int, nranks: int):
+    """Shared-memory job segment: native when the .so is available, else
+    the lockf fallback (no transport dispatch — used directly by the
+    routed transport's intra-host leg)."""
     if get_lib() is not None and not _force_fallback():
         return NativeShmJob(job, rank, nranks)
     return FallbackShmJob(job, rank, nranks)
 
 
-def make_window(job: str, name: str, rank: int, nranks: int, maxd: int,
-                shape, dtype):
-    coord = _tcp_coord(job)
-    if coord is not None:
-        from bluefog_tpu.native.tcp_transport import TcpShmWindow
-
-        return TcpShmWindow(job, name, rank, nranks, maxd, shape, dtype, coord)
+def make_shm_window(job: str, name: str, rank: int, nranks: int, maxd: int,
+                    shape, dtype):
     if get_lib() is not None and not _force_fallback():
         return NativeShmWindow(job, name, rank, nranks, maxd, shape, dtype)
     return FallbackShmWindow(job, name, rank, nranks, maxd, shape, dtype)
 
 
+def make_job(job: str, rank: int, nranks: int):
+    """Transport factory: hierarchical (shm intra-host + TCP inter-host)
+    when a hostmap is configured, else TCP (cross-host/DCN) when selected,
+    else pure shared memory."""
+    hostmap = os.environ.get("BLUEFOG_ISLAND_HOSTMAP")
+    if hostmap:
+        from bluefog_tpu.native.routed_transport import RoutedJob, parse_hostmap
+
+        hosts = parse_hostmap(hostmap, nranks)
+        return RoutedJob(job, rank, nranks, hosts, _derived_coord(job))
+    coord = _tcp_coord(job)
+    if coord is not None:
+        from bluefog_tpu.native.tcp_transport import TcpShmJob
+
+        return TcpShmJob(job, rank, nranks, coord)
+    return make_shm_job(job, rank, nranks)
+
+
+def make_window(job: str, name: str, rank: int, nranks: int, maxd: int,
+                shape, dtype):
+    hostmap = os.environ.get("BLUEFOG_ISLAND_HOSTMAP")
+    if hostmap:
+        from bluefog_tpu.native.routed_transport import (
+            RoutedWindow, parse_hostmap,
+        )
+
+        hosts = parse_hostmap(hostmap, nranks)
+        return RoutedWindow(job, name, rank, nranks, maxd, shape, dtype,
+                            hosts, _derived_coord(job))
+    coord = _tcp_coord(job)
+    if coord is not None:
+        from bluefog_tpu.native.tcp_transport import TcpShmWindow
+
+        return TcpShmWindow(job, name, rank, nranks, maxd, shape, dtype, coord)
+    return make_shm_window(job, name, rank, nranks, maxd, shape, dtype)
+
+
 def _force_fallback() -> bool:
     return os.environ.get("BLUEFOG_SHM_FALLBACK", "0") == "1"
+
+
+def _derived_coord(job: str) -> str:
+    """Explicit ``BLUEFOG_ISLAND_COORD`` or a job-deterministic localhost
+    port, below the Linux ephemeral range (32768+) so a transient client
+    socket never occupies it."""
+    coord = os.environ.get("BLUEFOG_ISLAND_COORD")
+    if coord:
+        return coord
+    import zlib
+
+    port = 10000 + zlib.crc32(job.encode()) % 20000
+    return f"127.0.0.1:{port}"
 
 
 def _tcp_coord(job: str) -> Optional[str]:
@@ -398,34 +476,17 @@ def _tcp_coord(job: str) -> Optional[str]:
     ``BLUEFOG_ISLAND_COORD=host:port`` selects it outright;
     ``BLUEFOG_ISLAND_TRANSPORT=tcp`` derives a job-deterministic localhost
     port (single-host testing)."""
-    coord = os.environ.get("BLUEFOG_ISLAND_COORD")
-    if coord:
-        return coord
+    if os.environ.get("BLUEFOG_ISLAND_COORD"):
+        return _derived_coord(job)
     if os.environ.get("BLUEFOG_ISLAND_TRANSPORT", "").lower() == "tcp":
-        import zlib
-
-        # below the Linux ephemeral range (32768+): a transient client
-        # socket must never occupy the derived coordinator port
-        port = 10000 + zlib.crc32(job.encode()) % 20000
-        return f"127.0.0.1:{port}"
+        return _derived_coord(job)
     return None
 
 
 def unlink_segment(job: str, suffix: str) -> None:
     """Best-effort unlink of one named segment (native object + fallback
     file); missing names are ignored."""
-    n = seg_name(job, suffix)
-    lib = get_lib()
-    if lib is not None:
-        try:
-            lib.bf_shm_unlink(n.encode())
-        except Exception:
-            pass
-    for d in {"/dev/shm", _FALLBACK_DIR}:
-        try:
-            os.unlink(os.path.join(d, n[1:]))
-        except OSError:
-            pass
+    _unlink_name(seg_name(job, suffix))
 
 
 def unlink_all(job: str, window_names=()) -> None:

@@ -378,7 +378,8 @@ class TcpShmWindow:
     def _store(self) -> _WinStore:
         return self.rt.server.windows[self._id]
 
-    def read(self, slot: int, collect: bool = False):
+    def read(self, slot: int, collect: bool = False, src=None):
+        del src
         with self.rt.server.lock:
             s = self._store().mail[slot]
             a = np.frombuffer(bytes(s.data), self.dtype).reshape(self.shape)
@@ -388,11 +389,13 @@ class TcpShmWindow:
                 s.p = 0.0
         return a.copy(), p, ver
 
-    def read_version(self, slot: int) -> int:
+    def read_version(self, slot: int, src=None) -> int:
+        del src
         with self.rt.server.lock:
             return self._store().mail[slot].version
 
-    def reset(self, slot: int) -> None:
+    def reset(self, slot: int, src=None) -> None:
+        del src
         with self.rt.server.lock:
             s = self._store().mail[slot]
             s.data[:] = b"\x00" * self.nbytes
@@ -408,7 +411,8 @@ class TcpShmWindow:
 
     # -- remote (one-sided) ops --------------------------------------------
     def write(self, dst: int, slot: int, array, p: float = 1.0,
-              accumulate: bool = False) -> None:
+              accumulate: bool = False, writer=None) -> None:
+        del writer
         if accumulate and self.dtype.kind != "f":
             raise TypeError(f"accumulate unsupported for dtype {self.dtype}")
         a = np.ascontiguousarray(np.asarray(array, self.dtype))
@@ -446,3 +450,6 @@ class TcpShmWindow:
         del unlink
         with self.rt.server.lock:
             self.rt.server.windows.pop(self._id, None)
+
+    def unlink_segments(self) -> None:
+        pass  # in-memory store, freed at close

@@ -123,7 +123,7 @@ def main():
             f"[rank {islands.rank()}] consensus err {err1:.2e}  "
             f"full-data loss {loss:.4f}  acc {acc:.3f}"
         )
-        ok = err1 < 1e-6 and acc > 0.8
+        ok = err1 < 1e-5 and acc > 0.8
         islands.barrier()
         islands.shutdown(unlink=(islands.rank() == 0))
         raise SystemExit(0 if ok else 1)
@@ -141,7 +141,7 @@ def main():
     errs = [e for e, _, _ in results]
     accs = [a for _, _, a in results]
     print(f"{args.nranks} islands, {dt:.1f}s wall")
-    if max(errs) < 1e-6 and min(accs) > 0.8:
+    if max(errs) < 1e-5 and min(accs) > 0.8:
         print("async islands demo OK")
     else:
         raise SystemExit(1)

@@ -82,6 +82,15 @@ def _worker_diffuse(rank, size, steps):
     return out
 
 
+def _worker_deterministic_suite(rank, size, steps):
+    """Diffusion + pull-combine + versions in ONE process set (keeps the
+    spawn count down: each spawn pays a fresh JAX import per child)."""
+    diffused = _worker_diffuse(rank, size, steps)
+    pulled = _worker_get(rank, size)
+    versions = _worker_versions(rank, size)
+    return diffused, pulled, versions
+
+
 def _worker_pushsum(rank, size, steps):
     islands.set_topology(topology_util.ExponentialTwoGraph(size))
     islands.turn_on_win_ops_with_associated_p()
@@ -130,7 +139,7 @@ def _worker_versions(rank, size):
 
 def _worker_mutex(rank, size, path):
     islands.set_topology(topology_util.FullyConnectedGraph(size))
-    for _ in range(40):
+    for _ in range(25):
         with islands.win_mutex("w", ranks=[0]):
             with open(path, "a") as f:
                 f.write(f"{rank} start\n")
@@ -163,22 +172,30 @@ def _weight_matrix(topo: nx.DiGraph) -> np.ndarray:
     return W
 
 
-def test_island_diffuse_matches_analytic_trajectory():
+def test_island_deterministic_suite():
+    """Barriered diffusion matches the analytic W^k trajectory; win_get
+    pull-combine matches the closed form; deposit versions count."""
     size, steps = 4, 7
-    res = islands.spawn(_worker_diffuse, size, args=(steps,))
+    res = islands.spawn(_worker_deterministic_suite, size, args=(steps,))
     topo = topology_util.RingGraph(size)
     W = np.linalg.matrix_power(_weight_matrix(topo), steps)
     x0 = np.stack([np.arange(3, dtype=np.float64) + r for r in range(size)])
     expected = W @ x0
-    for r in range(size):
-        np.testing.assert_allclose(res[r], expected[r], rtol=0, atol=1e-12)
+    for d in range(size):
+        diffused, pulled, versions = res[d]
+        np.testing.assert_allclose(diffused, expected[d], rtol=0, atol=1e-12)
+        nbrs = sorted(topo.predecessors(d))
+        u = 1.0 / (len(nbrs) + 1)
+        want = u * d + sum(u * s for s in nbrs)
+        np.testing.assert_allclose(pulled, np.full(2, want), atol=1e-12)
+        assert versions == {s: 6 for s in nbrs}, versions
 
 
 def test_island_async_pushsum_exact_average():
     """Fully asynchronous push-sum (random per-rank sleeps, no barriers in
     the hot loop) converges to the EXACT global average: the atomic
     collect conserves Σx and Σp under any interleaving."""
-    size, steps = 4, 120
+    size, steps = 4, 80
     res = islands.spawn(_worker_pushsum, size, args=(steps,), timeout=240.0)
     mean = np.mean([r * 10.0 for r in range(size)])
     for val, p in res:
@@ -186,32 +203,11 @@ def test_island_async_pushsum_exact_average():
         np.testing.assert_allclose(val, np.full(3, mean), rtol=0, atol=1e-8)
 
 
-def test_island_win_get_pull_combine():
-    size = 4
-    res = islands.spawn(_worker_get, size)
-    topo = topology_util.RingGraph(size)
-    for d in range(size):
-        nbrs = sorted(topo.predecessors(d))
-        u = 1.0 / (len(nbrs) + 1)
-        expected = u * d + sum(u * s for s in nbrs)
-        np.testing.assert_allclose(res[d], np.full(2, expected), atol=1e-12)
-
-
-def test_island_deposit_versions():
-    size = 4
-    res = islands.spawn(_worker_versions, size)
-    topo = topology_util.RingGraph(size)
-    for d in range(size):
-        nbrs = sorted(topo.predecessors(d))
-        # 1 seed (win_create) + 5 puts from each in-neighbor
-        assert res[d] == {s: 6 for s in nbrs}, res[d]
-
-
 def test_island_mutex_mutual_exclusion(tmp_path):
     path = str(tmp_path / "mutex.log")
     islands.spawn(_worker_mutex, 2, args=(path,))
     lines = open(path).read().splitlines()
-    assert len(lines) == 2 * 2 * 40
+    assert len(lines) == 2 * 2 * 25
     for i in range(0, len(lines), 2):
         r_start, kind_start = lines[i].split()
         r_end, kind_end = lines[i + 1].split()
@@ -382,7 +378,7 @@ def test_island_tcp_transport_mutex(monkeypatch, tmp_path):
     path = str(tmp_path / "mutex.log")
     islands.spawn(_worker_tcp_mutex, 2, args=(path,))
     lines = open(path).read().splitlines()
-    assert len(lines) == 2 * 2 * 40
+    assert len(lines) == 2 * 2 * 25
     for i in range(0, len(lines), 2):
         assert lines[i].split()[0] == lines[i + 1].split()[0]
 
@@ -423,3 +419,66 @@ def test_island_winput_optimizer_converges():
     assert ws.std(axis=0).max() < 0.05, ws
     for _, b in res:
         np.testing.assert_allclose(b, 0.0, atol=1e-6)
+
+
+def _worker_routed(rank, size, steps):
+    # hostmap "a,a,b,b": ranks 0-1 exchange via shm, 2-3 via shm,
+    # cross-pairs via TCP loopback — the hierarchical deployment shape
+    assert os.environ.get("BLUEFOG_ISLAND_HOSTMAP") == "a,a,b,b"
+    return _worker_diffuse(rank, size, steps)
+
+
+def _worker_routed_pushsum(rank, size, steps):
+    assert os.environ.get("BLUEFOG_ISLAND_HOSTMAP") == "a,a,b,b"
+    return _worker_pushsum(rank, size, steps)
+
+
+def _worker_routed_get_recreate(rank, size):
+    assert os.environ.get("BLUEFOG_ISLAND_HOSTMAP") == "a,a,b,b"
+    out = _worker_get(rank, size)
+    # recreate-after-free exercises the per-host designated unlink
+    islands.win_create(np.zeros(2), "g", zero_init=True)
+    fresh = islands.win_update("g")
+    islands.win_free("g")
+    return out, fresh.copy()
+
+
+def test_island_hierarchical_transport_diffuse(monkeypatch):
+    """shm intra-host + TCP inter-host, one window: barriered diffusion on
+    a ring that crosses the host boundary matches the analytic trajectory
+    (ring 0-1-2-3 has intra-host edges 0<->1, 2<->3 and inter-host edges
+    1<->2, 3<->0, so both transport legs carry traffic)."""
+    monkeypatch.setenv("BLUEFOG_ISLAND_HOSTMAP", "a,a,b,b")
+    size, steps = 4, 6
+    res = islands.spawn(_worker_routed, size, args=(steps,))
+    topo = topology_util.RingGraph(size)
+    W = np.linalg.matrix_power(_weight_matrix(topo), steps)
+    x0 = np.stack([np.arange(3, dtype=np.float64) + r for r in range(size)])
+    expected = W @ x0
+    for r in range(size):
+        np.testing.assert_allclose(res[r], expected[r], atol=1e-12)
+
+
+def test_island_hierarchical_transport_async_pushsum(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_ISLAND_HOSTMAP", "a,a,b,b")
+    size, steps = 4, 60
+    res = islands.spawn(_worker_routed_pushsum, size, args=(steps,),
+                        timeout=240.0)
+    mean = np.mean([r * 10.0 for r in range(size)])
+    for val, p in res:
+        assert p > 0
+        np.testing.assert_allclose(val, np.full(3, mean), rtol=0, atol=1e-8)
+
+
+def test_island_hierarchical_get_and_recreate(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_ISLAND_HOSTMAP", "a,a,b,b")
+    size = 4
+    res = islands.spawn(_worker_routed_get_recreate, size)
+    topo = topology_util.RingGraph(size)
+    for d in range(size):
+        nbrs = sorted(topo.predecessors(d))
+        u = 1.0 / (len(nbrs) + 1)
+        expected = u * d + sum(u * s for s in nbrs)
+        out, fresh = res[d]
+        np.testing.assert_allclose(out, np.full(2, expected), atol=1e-12)
+        np.testing.assert_allclose(fresh, np.zeros(2), atol=0)
