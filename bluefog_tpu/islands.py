@@ -68,6 +68,8 @@ __all__ = [
     "win_associated_p",
     "win_set_exposed",
     "push_sum_round",
+    "broadcast",
+    "broadcast_parameters",
     "DistributedWinPutOptimizer",
     "get_win_version",
     "turn_on_win_ops_with_associated_p",
@@ -534,6 +536,65 @@ def turn_on_win_ops_with_associated_p() -> None:
 
 def turn_off_win_ops_with_associated_p() -> None:
     _ctx().associated_p = False
+
+
+def broadcast(tensor, root: int = 0, name: Optional[str] = None):
+    """Collective broadcast via the exposed-tensor region: ``win_create``
+    already exposes every rank's tensor (and ends with a barrier), so the
+    body is just a one-sided read of root's exposure (reference
+    ``bf.broadcast`` [U]; the islands use-case is the consistent-start
+    idiom).  All ranks must call it in the same order."""
+    ctx = _ctx()
+    t = _to_host(tensor)
+    if name is None:
+        n = getattr(ctx, "_bcast_counter", 0)
+        ctx._bcast_counter = n + 1
+        name = f"_bcast_auto{n}"  # same order on all ranks -> same name
+    if not win_create(t, name, zero_init=True):
+        raise ValueError(
+            f"broadcast window name {name!r} collides with a live window"
+        )
+    try:
+        out, _, _ = _win(name).shm.read_exposed(root)
+        # every rank reads BEFORE anyone tears the window down (the TCP
+        # store vanishes at close)
+        barrier()
+    finally:
+        win_free(name)
+    return out
+
+
+def broadcast_parameters(params, root: int = 0):
+    """Broadcast a pytree of parameters from ``root`` — the consistent
+    initialization idiom (reference ``bf.broadcast_parameters`` [U]).
+    Leaves are packed into ONE flat buffer per dtype (like the WinPut
+    optimizer's fusion), so the coordination cost is a couple of window
+    lifecycles regardless of leaf count.  Returns the tree with every leaf
+    replaced by root's value, preserving leaf container kind (numpy vs
+    jax) and dtype."""
+    import jax
+    import jax.numpy as jnp
+
+    flat, treedef = jax.tree_util.tree_flatten(params)
+    by_dtype: Dict = {}
+    for i, leaf in enumerate(flat):
+        by_dtype.setdefault(np.asarray(leaf).dtype, []).append(i)
+    for dt, idxs in sorted(by_dtype.items(), key=lambda kv: str(kv[0])):
+        packed = np.concatenate(
+            [np.asarray(flat[i], dtype=dt).ravel() for i in idxs]
+        )
+        got = broadcast(packed, root=root)
+        off = 0
+        for i in idxs:
+            leaf = flat[i]
+            size = int(np.asarray(leaf).size)
+            arr = got[off:off + size].reshape(np.shape(leaf))
+            if isinstance(leaf, np.ndarray):
+                flat[i] = arr.astype(leaf.dtype, copy=False)
+            else:
+                flat[i] = jnp.asarray(arr, dtype=leaf.dtype)
+            off += size
+    return jax.tree_util.tree_unflatten(treedef, flat)
 
 
 # ---------------------------------------------------------------------------

@@ -83,12 +83,15 @@ def _worker_diffuse(rank, size, steps):
 
 
 def _worker_deterministic_suite(rank, size, steps):
-    """Diffusion + pull-combine + versions in ONE process set (keeps the
-    spawn count down: each spawn pays a fresh JAX import per child)."""
+    """Diffusion + pull-combine + versions + broadcast in ONE process set
+    (keeps the spawn count down: each spawn pays a fresh JAX import per
+    child)."""
     diffused = _worker_diffuse(rank, size, steps)
     pulled = _worker_get(rank, size)
     versions = _worker_versions(rank, size)
-    return diffused, pulled, versions
+    tree = {"a": np.full((3,), float(rank)), "b": np.arange(2.0) * rank}
+    bcast = islands.broadcast_parameters(tree, root=1)
+    return diffused, pulled, versions, bcast
 
 
 def _worker_pushsum(rank, size, steps):
@@ -182,20 +185,23 @@ def test_island_deterministic_suite():
     x0 = np.stack([np.arange(3, dtype=np.float64) + r for r in range(size)])
     expected = W @ x0
     for d in range(size):
-        diffused, pulled, versions = res[d]
+        diffused, pulled, versions, bcast = res[d]
         np.testing.assert_allclose(diffused, expected[d], rtol=0, atol=1e-12)
         nbrs = sorted(topo.predecessors(d))
         u = 1.0 / (len(nbrs) + 1)
         want = u * d + sum(u * s for s in nbrs)
         np.testing.assert_allclose(pulled, np.full(2, want), atol=1e-12)
         assert versions == {s: 6 for s in nbrs}, versions
+        # broadcast_parameters: every rank holds root 1's leaves
+        np.testing.assert_allclose(bcast["a"], np.full(3, 1.0), atol=0)
+        np.testing.assert_allclose(bcast["b"], np.arange(2.0), atol=0)
 
 
 def test_island_async_pushsum_exact_average():
     """Fully asynchronous push-sum (random per-rank sleeps, no barriers in
     the hot loop) converges to the EXACT global average: the atomic
     collect conserves Σx and Σp under any interleaving."""
-    size, steps = 4, 80
+    size, steps = 4, 60
     res = islands.spawn(_worker_pushsum, size, args=(steps,), timeout=240.0)
     mean = np.mean([r * 10.0 for r in range(size)])
     for val, p in res:
@@ -332,53 +338,38 @@ def test_island_update_rejects_unknown_neighbor(tmp_path):
         islands.shutdown(unlink=True)
 
 
-def _worker_tcp_diffuse(rank, size, steps):
+def _worker_tcp_suite(rank, size, steps, path):
+    """Diffusion + async push-sum + mutex over the TCP transport in ONE
+    process set (each spawn pays a fresh JAX import per child)."""
     assert os.environ.get("BLUEFOG_ISLAND_TRANSPORT") == "tcp"
-    return _worker_diffuse(rank, size, steps)
+    diffused = _worker_diffuse(rank, size, steps)
+    pushed = _worker_pushsum(rank, size, 40)
+    _worker_mutex(rank, size, path)
+    return diffused, pushed
 
 
-def _worker_tcp_pushsum(rank, size, steps):
-    assert os.environ.get("BLUEFOG_ISLAND_TRANSPORT") == "tcp"
-    return _worker_pushsum(rank, size, steps)
-
-
-def _worker_tcp_mutex(rank, size, path):
-    assert os.environ.get("BLUEFOG_ISLAND_TRANSPORT") == "tcp"
-    return _worker_mutex(rank, size, path)
-
-
-def test_island_tcp_transport_diffuse(monkeypatch):
-    """The TCP (cross-host/DCN) transport: same mailbox protocol over
-    sockets — barriered diffusion matches the analytic trajectory."""
+def test_island_tcp_transport_suite(monkeypatch, tmp_path):
+    """The TCP (cross-host/DCN) transport: barriered diffusion matches the
+    analytic trajectory; asynchronous push-sum reaches the exact average
+    (the write ack gives MPI_Win_flush-style completion); the remote mutex
+    excludes."""
     monkeypatch.setenv("BLUEFOG_ISLAND_TRANSPORT", "tcp")
+    path = str(tmp_path / "mutex.log")
     size, steps = 4, 5
-    res = islands.spawn(_worker_tcp_diffuse, size, args=(steps,))
+    res = islands.spawn(_worker_tcp_suite, size, args=(steps, path),
+                        timeout=300.0)
     topo = topology_util.RingGraph(size)
     W = np.linalg.matrix_power(_weight_matrix(topo), steps)
     x0 = np.stack([np.arange(3, dtype=np.float64) + r for r in range(size)])
     expected = W @ x0
-    for r in range(size):
-        np.testing.assert_allclose(res[r], expected[r], atol=1e-12)
-
-
-def test_island_tcp_transport_async_pushsum(monkeypatch):
-    """Asynchronous exact-average push-sum over the TCP transport (the
-    one-sided write ack gives MPI_Win_flush-style completion)."""
-    monkeypatch.setenv("BLUEFOG_ISLAND_TRANSPORT", "tcp")
-    size, steps = 4, 60
-    res = islands.spawn(_worker_tcp_pushsum, size, args=(steps,), timeout=240.0)
     mean = np.mean([r * 10.0 for r in range(size)])
-    for val, p in res:
+    for r in range(size):
+        diffused, (val, p) = res[r]
+        np.testing.assert_allclose(diffused, expected[r], atol=1e-12)
         assert p > 0
         np.testing.assert_allclose(val, np.full(3, mean), rtol=0, atol=1e-8)
-
-
-def test_island_tcp_transport_mutex(monkeypatch, tmp_path):
-    monkeypatch.setenv("BLUEFOG_ISLAND_TRANSPORT", "tcp")
-    path = str(tmp_path / "mutex.log")
-    islands.spawn(_worker_tcp_mutex, 2, args=(path,))
     lines = open(path).read().splitlines()
-    assert len(lines) == 2 * 2 * 25
+    assert len(lines) == 2 * size * 25
     for i in range(0, len(lines), 2):
         assert lines[i].split()[0] == lines[i + 1].split()[0]
 
@@ -402,7 +393,7 @@ def _worker_winput_opt(rank, size, steps):
     for _ in range(steps):
         grads = {"w": params["w"] - c, "b": params["b"] * 0.0}
         params, state = opt.step(params, grads, state)
-        time.sleep(float(rng.random()) * 0.001)
+        time.sleep(float(rng.random()) * 0.0005)
     islands.barrier()
     params = opt.settle(params, rounds=10)
     opt.free()
@@ -410,7 +401,7 @@ def _worker_winput_opt(rank, size, steps):
 
 
 def test_island_winput_optimizer_converges():
-    size, steps = 4, 80
+    size, steps = 4, 50
     res = islands.spawn(_worker_winput_opt, size, args=(steps,), timeout=240.0)
     target = (size - 1) / 2.0  # mean of the per-rank optima
     ws = np.stack([w for w, _ in res])
@@ -421,64 +412,41 @@ def test_island_winput_optimizer_converges():
         np.testing.assert_allclose(b, 0.0, atol=1e-6)
 
 
-def _worker_routed(rank, size, steps):
-    # hostmap "a,a,b,b": ranks 0-1 exchange via shm, 2-3 via shm,
-    # cross-pairs via TCP loopback — the hierarchical deployment shape
+def _worker_routed_suite(rank, size, steps):
+    """Hierarchical transport (hostmap "a,a,b,b": ranks 0-1 via shm,
+    2-3 via shm, cross-pairs via TCP loopback): diffusion + async push-sum
+    + pull-combine + recreate-after-free in ONE process set."""
     assert os.environ.get("BLUEFOG_ISLAND_HOSTMAP") == "a,a,b,b"
-    return _worker_diffuse(rank, size, steps)
-
-
-def _worker_routed_pushsum(rank, size, steps):
-    assert os.environ.get("BLUEFOG_ISLAND_HOSTMAP") == "a,a,b,b"
-    return _worker_pushsum(rank, size, steps)
-
-
-def _worker_routed_get_recreate(rank, size):
-    assert os.environ.get("BLUEFOG_ISLAND_HOSTMAP") == "a,a,b,b"
-    out = _worker_get(rank, size)
+    diffused = _worker_diffuse(rank, size, steps)
+    pushed = _worker_pushsum(rank, size, 40)
+    pulled = _worker_get(rank, size)
     # recreate-after-free exercises the per-host designated unlink
     islands.win_create(np.zeros(2), "g", zero_init=True)
     fresh = islands.win_update("g")
     islands.win_free("g")
-    return out, fresh.copy()
+    return diffused, pushed, pulled, fresh.copy()
 
 
-def test_island_hierarchical_transport_diffuse(monkeypatch):
-    """shm intra-host + TCP inter-host, one window: barriered diffusion on
-    a ring that crosses the host boundary matches the analytic trajectory
-    (ring 0-1-2-3 has intra-host edges 0<->1, 2<->3 and inter-host edges
-    1<->2, 3<->0, so both transport legs carry traffic)."""
+def test_island_hierarchical_transport_suite(monkeypatch):
+    """shm intra-host + TCP inter-host, one window: the ring 0-1-2-3 has
+    intra-host edges 0<->1, 2<->3 and inter-host edges 1<->2, 3<->0, so
+    both transport legs carry traffic in every phase."""
     monkeypatch.setenv("BLUEFOG_ISLAND_HOSTMAP", "a,a,b,b")
     size, steps = 4, 6
-    res = islands.spawn(_worker_routed, size, args=(steps,))
+    res = islands.spawn(_worker_routed_suite, size, args=(steps,),
+                        timeout=300.0)
     topo = topology_util.RingGraph(size)
     W = np.linalg.matrix_power(_weight_matrix(topo), steps)
     x0 = np.stack([np.arange(3, dtype=np.float64) + r for r in range(size)])
     expected = W @ x0
-    for r in range(size):
-        np.testing.assert_allclose(res[r], expected[r], atol=1e-12)
-
-
-def test_island_hierarchical_transport_async_pushsum(monkeypatch):
-    monkeypatch.setenv("BLUEFOG_ISLAND_HOSTMAP", "a,a,b,b")
-    size, steps = 4, 60
-    res = islands.spawn(_worker_routed_pushsum, size, args=(steps,),
-                        timeout=240.0)
     mean = np.mean([r * 10.0 for r in range(size)])
-    for val, p in res:
+    for d in range(size):
+        diffused, (val, p), pulled, fresh = res[d]
+        np.testing.assert_allclose(diffused, expected[d], atol=1e-12)
         assert p > 0
         np.testing.assert_allclose(val, np.full(3, mean), rtol=0, atol=1e-8)
-
-
-def test_island_hierarchical_get_and_recreate(monkeypatch):
-    monkeypatch.setenv("BLUEFOG_ISLAND_HOSTMAP", "a,a,b,b")
-    size = 4
-    res = islands.spawn(_worker_routed_get_recreate, size)
-    topo = topology_util.RingGraph(size)
-    for d in range(size):
         nbrs = sorted(topo.predecessors(d))
         u = 1.0 / (len(nbrs) + 1)
-        expected = u * d + sum(u * s for s in nbrs)
-        out, fresh = res[d]
-        np.testing.assert_allclose(out, np.full(2, expected), atol=1e-12)
+        want = u * d + sum(u * s for s in nbrs)
+        np.testing.assert_allclose(pulled, np.full(2, want), atol=1e-12)
         np.testing.assert_allclose(fresh, np.zeros(2), atol=0)
