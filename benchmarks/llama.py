@@ -52,6 +52,10 @@ def main():
                     choices=sorted(PRESETS))
     ap.add_argument("--iters", type=int, default=10 if on_tpu else 3)
     ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--attn-impl", default="auto",
+                    choices=["auto", "xla", "pallas", "dense"],
+                    help="flash-attention implementation (dense = model's "
+                    "built-in softmax attention)")
     args = ap.parse_args()
     cfg = PRESETS[args.preset]
 
@@ -63,7 +67,13 @@ def main():
     model = LlamaLM(
         vocab_size=cfg["vocab"], hidden_size=cfg["hidden"],
         num_layers=cfg["layers"], num_heads=cfg["heads"], dff=cfg["dff"],
-        attention_fn=make_flash_attention_fn() if on_tpu else None,
+        attention_fn=(
+            # explicit pallas/xla is honored everywhere (interpret mode off
+            # TPU); only "dense" and the off-TPU auto default skip flash
+            None if args.attn_impl == "dense"
+            or (args.attn_impl == "auto" and not on_tpu)
+            else make_flash_attention_fn(impl=args.attn_impl)
+        ),
     )
     B, T = cfg["batch"], cfg["seq"]
     ids0 = jnp.ones((B, T), jnp.int32)

@@ -1,12 +1,15 @@
 """Flash attention: blockwise XLA forward/backward + a Pallas TPU kernel.
 
-Two interchangeable forwards behind one ``impl`` switch ("auto" default):
-an online-softmax blockwise computation in plain XLA (the default compiled
-path — measured faster end-to-end on the benched v5e, where XLA's fused
-matmul/softmax stages beat Mosaic's per-block scheduling) and a hand Pallas
-kernel (selectable via ``impl="pallas"``; always used in interpret mode so
-CPU tests exercise the kernel logic).  Both share the custom-VJP blockwise
-backward and produce identical (o, lse) contracts.
+Two interchangeable forwards behind one ``impl`` switch ("auto" default =
+the Pallas kernel): a hand Pallas kernel and an online-softmax blockwise
+computation in plain XLA (``impl="xla"``).  The XLA path wins a
+forward-only microbenchmark by ~25-35% on the benched v5e, but END-TO-END
+TRAINING with it measured 13x slower (Llama-134M S=2048: 4.8k vs 63.0k
+tok/s/chip) — the unrolled blockwise forward inside the custom-vjp
+recompute wrecks the backward schedule under jit — so auto stays Pallas.
+Both share the custom-VJP blockwise backward and produce identical
+(o, lse) contracts; interpret mode always runs the Pallas logic so CPU
+tests exercise the kernel.
 
 No sibling in the reference — it has no attention at all (SURVEY.md §2.3) —
 but the rebuild's transformer workloads (BERT push-sum fine-tune, Llama
@@ -236,11 +239,10 @@ def _blockwise_fwd_xla(q, k, v, q_start, k_start, *, scale, causal, block_k,
     """Online-softmax blockwise forward in plain XLA; same math and
     (o, lse) contract as the Pallas kernel.
 
-    On the benched v5e, XLA's einsum pipeline runs this ~25-35% faster than
-    the hand kernel end-to-end (big fused matmul+softmax stages beat
-    Mosaic's per-block scheduling there), so it is the default compiled
-    path; the Pallas kernel remains selectable (``impl="pallas"``) and is
-    what interpret-mode tests exercise.
+    Selectable via ``impl="xla"``.  Forward-only it beats the hand kernel
+    by ~25-35% on the benched v5e (big fused matmul+softmax stages), but
+    inside the custom-vjp's backward recompute it measured 13x slower
+    end-to-end on Llama training, so it is NOT the auto default.
     """
     bh, tq, d = q.shape
     tk = k.shape[1]
@@ -386,9 +388,17 @@ def _blockwise_bwd(q, k, v, o, lse, q_start, k_start, g, g_lse,
 def _fwd_dispatch(q, k, v, q_start, k_start, *, scale, causal, block_q,
                   block_k, interpret, tri_delta, impl):
     """Choose the forward implementation (static): "pallas", "xla", or
-    "auto" (= XLA blockwise when compiling, Pallas in interpret mode so the
-    kernel logic keeps CPU test coverage)."""
-    use_xla = impl == "xla" or (impl == "auto" and not interpret)
+    "auto" (= Pallas kernel; "xla" remains selectable).
+
+    Auto history: the XLA blockwise forward wins a forward-only
+    microbenchmark by ~25-35% on the benched v5e, and auto briefly
+    pointed at it — but END-TO-END TRAINING with it measured 13x slower
+    on the Llama-134M S=2048 benchmark (4.8k vs 63.0k tok/s/chip): under
+    jit the unrolled per-block forward inside the custom-vjp recompute
+    blows up the backward's schedule.  Training throughput is the
+    headline workload, so auto = Pallas; forward-heavy callers can still
+    pass impl="xla"."""
+    use_xla = impl == "xla"
     if use_xla:
         return _blockwise_fwd_xla(
             q, k, v, q_start, k_start,
@@ -457,9 +467,10 @@ def flash_attention_with_lse(
     calls this with the rotating key-block offset.  Rows with no visible
     keys return out=0, lse≈-1e30, which merge correctly.
 
-    ``impl``: "auto" (default; XLA blockwise when compiling, Pallas kernel
-    in interpret mode), "xla", or "pallas".  ``block_q`` only affects the
-    Pallas kernel; the XLA path blocks on ``block_k`` alone.
+    ``impl``: "auto" (default = the Pallas kernel — see module docstring
+    for the measured 13x training-throughput gap vs "xla"), "xla", or
+    "pallas".  ``block_q`` only affects the Pallas kernel; the XLA path
+    blocks on ``block_k`` alone.
     """
     if impl not in ("auto", "xla", "pallas"):
         raise ValueError(f"impl must be auto/xla/pallas, got {impl!r}")
