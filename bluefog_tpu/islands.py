@@ -321,8 +321,10 @@ def win_put(tensor, name: str, dst_weights: WeightDict = None) -> bool:
         ctx = _ctx()
         win = _win(name)
         t = _to_host(tensor).astype(win.shm.dtype, copy=False)
-        win.self_tensor = np.array(t, copy=True)
-        win.shm.expose(win.self_tensor, win.p_self)
+        # alias, don't copy: upstream the window aliases the user tensor's
+        # memory, and the shm exposure below is already a stable snapshot
+        win.self_tensor = t
+        win.shm.expose(t, win.p_self)
         targets = _check_dst(win, dst_weights)
         for d in targets:
             wgt = 1.0 if dst_weights is None else float(dst_weights[d])
@@ -480,10 +482,10 @@ def win_set_exposed(name: str, tensor, associated_p: Optional[float] = None) -> 
     t = _to_host(tensor).astype(win.shm.dtype, copy=False)
     if t.shape != win.shm.shape:
         raise ValueError(f"shape {t.shape} != window shape {win.shm.shape}")
-    win.self_tensor = np.array(t, copy=True)
+    win.self_tensor = t  # alias (reference windows alias the tensor [U])
     if associated_p is not None:
         win.p_self = float(associated_p)
-    win.shm.expose(win.self_tensor, win.p_self)
+    win.shm.expose(t, win.p_self)
 
 
 def get_win_version(name: str) -> Dict[int, int]:
