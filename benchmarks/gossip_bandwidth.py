@@ -43,7 +43,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import bluefog_tpu as bf
 from bluefog_tpu import topology_util
 from bluefog_tpu.core import basics
-from bench import _sync  # the tunneled-TPU sync workaround, one copy only
+from bluefog_tpu.ops import device_sync as _sync  # proven host round-trip
 
 
 def _island_worker(rank, size, mb, iters, warmup, topo_name):
@@ -73,28 +73,38 @@ def _island_worker(rank, size, mb, iters, warmup, topo_name):
     return out_deg * elems * 4 * iters, dt
 
 
-def run_islands(args):
-    from bluefog_tpu import islands
-
+def measure_islands(nprocs: int, mb: float, iters: int, warmup: int,
+                    topology: str = "exp2") -> dict:
+    """True one-sided win_put bandwidth: N OS processes depositing through
+    the native shm mailbox.  Returns the metric dict (bench.py reuses this
+    so BENCH_r{N}.json carries both BASELINE.json tracked metrics)."""
     import functools
+
+    from bluefog_tpu import islands
 
     res = islands.spawn(
         functools.partial(
-            _island_worker, mb=args.mb, iters=args.iters,
-            warmup=args.warmup, topo_name=args.topology,
+            _island_worker, mb=mb, iters=iters,
+            warmup=warmup, topo_name=topology,
         ),
-        args.islands, timeout=600.0,
+        nprocs, timeout=600.0,
     )
     total_bytes = sum(b for b, _ in res)
     max_dt = max(dt for _, dt in res)
     gbs = total_bytes / max_dt / 1e9
-    print(json.dumps({
-        "metric": f"island win_put shm-mailbox bandwidth ({args.topology}, "
-                  f"{args.islands} processes, {args.mb:g} MB payload)",
+    return {
+        "metric": f"island win_put shm-mailbox bandwidth ({topology}, "
+                  f"{nprocs} processes, {mb:g} MB payload)",
         "value": round(gbs, 3),
         "unit": "GB/s aggregate",
         "vs_baseline": 0.0,
-    }))
+    }
+
+
+def run_islands(args):
+    print(json.dumps(measure_islands(
+        args.islands, args.mb, args.iters, args.warmup, args.topology
+    )))
 
 
 def main():
@@ -114,13 +124,21 @@ def main():
         return
 
     bf.init()
+    print(json.dumps(measure_spmd(args.mb, args.iters, args.warmup,
+                                  args.topology)))
+
+
+def measure_spmd(mb: float, iters: int, warmup: int,
+                 topology: str = "exp2") -> dict:
+    """SPMD win_put-emulation bandwidth on the live mesh (``bf.init()`` must
+    have run).  Returns the metric dict."""
     n = bf.size()
-    topo = (topology_util.ExponentialTwoGraph(n) if args.topology == "exp2"
+    topo = (topology_util.ExponentialTwoGraph(n) if topology == "exp2"
             else topology_util.RingGraph(n))
     bf.set_topology(topo)
     plan = basics.context().plan
 
-    elems = max(int(args.mb * 1e6 / 4), 1)
+    elems = max(int(mb * 1e6 / 4), 1)
     x = jnp.ones((n, elems), jnp.float32)
     payload_bytes = elems * 4
     # one send per out-edge per exchange, summed over ranks
@@ -129,14 +147,14 @@ def main():
     def timed(fn):
         """fn() -> device array the iteration's work flows into."""
         out = fn()  # always at least one un-timed call to trigger compile
-        for _ in range(max(args.warmup - 1, 0)):
+        for _ in range(max(warmup - 1, 0)):
             out = fn()
         _sync(out)
         t0 = time.perf_counter()
-        for _ in range(args.iters):
+        for _ in range(iters):
             out = fn()
         _sync(out)
-        return (time.perf_counter() - t0) / args.iters
+        return (time.perf_counter() - t0) / iters
 
     # --- win_put phase (the metric; fused put+update = one dispatch) ---
     bf.win_create(x, "gossip_bw")
@@ -148,13 +166,13 @@ def main():
 
     gbs_put = edges * payload_bytes / t_put / 1e9
     gbs_nar = edges * payload_bytes / t_nar / 1e9
-    print(json.dumps({
-        "metric": f"win_put gossip bandwidth ({args.topology}, {n} ranks, "
-                  f"{args.mb:g} MB payload)",
+    return {
+        "metric": f"win_put gossip bandwidth ({topology}, {n} ranks, "
+                  f"{mb:g} MB payload)",
         "value": round(gbs_put, 3),
         "unit": "GB/s aggregate",
         "vs_baseline": round(gbs_put / gbs_nar, 4) if gbs_nar else 0.0,
-    }))
+    }
 
 
 if __name__ == "__main__":

@@ -41,6 +41,8 @@ from bluefog_tpu.core.basics import (
 )
 
 from bluefog_tpu.ops import (
+    Handle,
+    device_sync,
     allreduce,
     allreduce_nonblocking,
     allgather,

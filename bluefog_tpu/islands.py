@@ -35,6 +35,7 @@ atomicity and that makes x/p debiasing converge to the exact average.
 from __future__ import annotations
 
 import contextlib
+import itertools
 import os
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
@@ -654,7 +655,12 @@ class DistributedWinPutOptimizer:
             shapes = [tuple(np.shape(flat[i])) for i in idxs]
             sizes = [int(np.prod(s, dtype=np.int64)) for s in shapes]
             packed = self._pack(flat, idxs, dt)
-            win_create(packed, f"{self.prefix}.{g}")
+            if not win_create(packed, f"{self.prefix}.{g}"):
+                raise RuntimeError(
+                    f"window '{self.prefix}.{g}' already exists — two "
+                    "optimizers share window_prefix (pass a distinct "
+                    "prefix) or a previous instance was not freed"
+                )
             self._groups.append((idxs, shapes, sizes, dt))
         return self.base.init(params)
 
@@ -737,6 +743,11 @@ def _spawn_worker(fn, r, nranks, job, args, q):
     shutdown(unlink=(r == 0))
 
 
+# distinguishes concurrent spawn() calls from one parent: pid alone is not
+# enough (same fn name + nranks would collide on shm job/barrier segments)
+_spawn_counter = itertools.count()
+
+
 def spawn(fn, nranks: int, job: Optional[str] = None, timeout: float = 120.0,
           args: Tuple = (), method: str = "spawn") -> List:
     """Run ``fn(rank, size, *args)`` in ``nranks`` processes, each
@@ -753,8 +764,8 @@ def spawn(fn, nranks: int, job: Optional[str] = None, timeout: float = 120.0,
     import multiprocessing as mp
 
     job = job or (
-        f"spawn{os.getpid()}_"
-        f"{abs(hash((getattr(fn, '__name__', 'fn'), nranks))) % 10**6}"
+        f"spawn{os.getpid()}_{next(_spawn_counter)}_"
+        f"{getattr(fn, '__name__', 'fn')[:32]}"
     )
     mp_ctx = mp.get_context(method)
     q = mp_ctx.Queue()
@@ -786,7 +797,11 @@ def spawn(fn, nranks: int, job: Optional[str] = None, timeout: float = 120.0,
         if p.is_alive():
             p.terminate()
             failures.append("child did not exit")
+    # reclaim segments on EVERY path (spawn's children are on this host by
+    # definition): rank 0's collective unlink normally already ran, but a
+    # child terminated mid-teardown — e.g. under heavy machine load the
+    # 10s join expired — must not leave /dev/shm litter behind
+    shm_native.unlink_all(job, [])
     if failures:
-        shm_native.unlink_all(job, [])
         raise RuntimeError("island spawn failed:\n" + "\n".join(failures))
     return [results[r] for r in range(nranks)]

@@ -367,7 +367,8 @@ void* bf_shm_win_create(const char* name, int64_t rank, int64_t nranks,
     hdr->dtype = dtype;
     publish_init(win->seg.base, offsetof(WinHeader, init_done));
   } else if (hdr->magic != kMagic || hdr->nranks != nranks ||
-             hdr->maxd != win->maxd || hdr->nbytes != nbytes) {
+             hdr->maxd != win->maxd || hdr->nbytes != nbytes ||
+             hdr->dtype != dtype) {
     segment_close(&win->seg, false);
     delete win;
     return nullptr;

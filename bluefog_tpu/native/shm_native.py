@@ -109,6 +109,11 @@ class NativeShmWindow:
         if accumulate and self._code == 0:
             raise TypeError(f"accumulate unsupported for dtype {self.dtype}")
         a = _as_contiguous(array, self.dtype)
+        if a.nbytes != self.nbytes:
+            raise ValueError(
+                f"win_put payload has {a.nbytes} bytes but window "
+                f"{self._name} expects {self.nbytes} (shape {self.shape})"
+            )
         self._lib.bf_shm_win_write(
             self._h, int(dst), int(slot),
             a.ctypes.data_as(ctypes.c_void_p), float(p),
@@ -136,6 +141,11 @@ class NativeShmWindow:
 
     def expose(self, array, p: float = 1.0) -> None:
         a = _as_contiguous(array, self.dtype)
+        if a.nbytes != self.nbytes:
+            raise ValueError(
+                f"expose payload has {a.nbytes} bytes but window "
+                f"{self._name} expects {self.nbytes} (shape {self.shape})"
+            )
         self._lib.bf_shm_win_expose(
             self._h, a.ctypes.data_as(ctypes.c_void_p), float(p)
         )

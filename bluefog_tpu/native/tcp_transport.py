@@ -38,6 +38,8 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from bluefog_tpu.common.logging_util import logger
+
 # ops
 _OP_WRITE = 1          # deposit into (my) mail slot: mode 0 put, 1 accumulate
 _OP_READ_EXPOSED = 2   # return my exposed tensor
@@ -139,6 +141,18 @@ class _Server:
                     with self.lock:
                         w = self.windows[win_id]
                         s = w.mail[slot]
+                        if len(payload) != w.nbytes:
+                            # log, then drop the faulty request AND the
+                            # connection: the writer sees ConnectionError at
+                            # the ack instead of corrupting the slot (a
+                            # bytearray slice-assign would silently RESIZE it)
+                            logger.error(
+                                "rank %d mailbox: win write to %d[%d]: "
+                                "payload %dB != window %dB — dropping "
+                                "connection", self.rank, win_id, slot,
+                                len(payload), w.nbytes,
+                            )
+                            raise ConnectionError("size mismatch")
                         if mode == 1 and w.dtype.kind == "f":
                             a = np.frombuffer(bytes(s.data), w.dtype) + \
                                 np.frombuffer(payload, w.dtype)
@@ -239,8 +253,18 @@ class _Peers:
                 conn.settimeout(None)
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 self.conns[rank] = conn
-            _send_msg(conn, op, win_id, slot, mode, p, payload)
-            return _recv_msg(conn)
+            try:
+                _send_msg(conn, op, win_id, slot, mode, p, payload)
+                return _recv_msg(conn)
+            except (ConnectionError, OSError):
+                # evict the dead socket so the NEXT request reconnects
+                # instead of failing forever on a cached corpse
+                self.conns.pop(rank, None)
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                raise
 
     def close(self):
         for c in self.conns.values():
@@ -403,6 +427,11 @@ class TcpShmWindow:
 
     def expose(self, array, p: float = 1.0) -> None:
         a = np.ascontiguousarray(np.asarray(array, self.dtype))
+        if a.nbytes != self.nbytes:
+            raise ValueError(
+                f"expose payload has {a.nbytes} bytes but window "
+                f"expects {self.nbytes} (shape {self.shape})"
+            )
         with self.rt.server.lock:
             s = self._store().exposed
             s.data[:] = a.tobytes()
@@ -416,6 +445,11 @@ class TcpShmWindow:
         if accumulate and self.dtype.kind != "f":
             raise TypeError(f"accumulate unsupported for dtype {self.dtype}")
         a = np.ascontiguousarray(np.asarray(array, self.dtype))
+        if a.nbytes != self.nbytes:
+            raise ValueError(
+                f"win_put payload has {a.nbytes} bytes but window "
+                f"expects {self.nbytes} (shape {self.shape})"
+            )
         if dst == self.rt.rank:
             # local fast path, same semantics
             with self.rt.server.lock:
