@@ -20,6 +20,7 @@ import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import networkx as nx
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -59,6 +60,9 @@ __all__ = [
     "unified_mpi_window_model_supported",
     "rank_major_sharding",
     "replicated_sharding",
+    "local_ranks",
+    "to_rank_major_global",
+    "local_slice",
 ]
 
 # Mesh axis names.  A single flat axis for rank-level gossip; a factored
@@ -417,3 +421,70 @@ def rank_major_sharding(ctx: Optional[BlueFogContext] = None) -> NamedSharding:
 def replicated_sharding(ctx: Optional[BlueFogContext] = None) -> NamedSharding:
     ctx = ctx or context()
     return NamedSharding(ctx.mesh, P())
+
+
+def local_ranks() -> List[int]:
+    """Global rank indices owned by THIS process, in global order (one
+    contiguous block under the machine-major layout)."""
+    ctx = context()
+    pi = jax.process_index()
+    return [i for i, d in enumerate(ctx.devices) if d.process_index == pi]
+
+
+def to_rank_major_global(x):
+    """Pytree of host arrays → rank-major arrays on the mesh.
+
+    Single process: plain device transfer (every rank is addressable).
+    Multi-process (the reference's per-node ``bfrun`` world, SURVEY.md
+    §3.5): eager host data cannot become a global sharded array by
+    ``jnp.asarray`` — each process supplies EITHER the full rank-major
+    array ``[size, ...]`` (identical across processes, e.g. replicated
+    params) OR just its own ranks' rows ``[len(local_ranks()), ...]``
+    (e.g. its data shards), and the global array is assembled with
+    ``jax.make_array_from_process_local_data``.  Arrays that are already
+    global pass through untouched.
+    """
+    ctx = context()
+    if jax.process_count() == 1:
+        return jax.tree_util.tree_map(jnp.asarray, x)
+    sh = rank_major_sharding(ctx)
+    mine = local_ranks()
+
+    def leaf(a):
+        if isinstance(a, jax.Array) and not a.is_fully_addressable:
+            return a  # already a global array
+        a = np.asarray(a)
+        if a.ndim == 0 or a.shape[0] not in (ctx.size, len(mine)):
+            raise ValueError(
+                f"rank-major leaf has leading dim {a.shape[:1]}; expected "
+                f"size={ctx.size} (full, replicated across processes) or "
+                f"{len(mine)} (this process's rank rows {mine})"
+            )
+        gshape = (ctx.size,) + a.shape[1:]
+        return jax.make_array_from_process_local_data(sh, a, gshape)
+
+    return jax.tree_util.tree_map(leaf, x)
+
+
+def local_slice(x):
+    """This process's rank rows of a rank-major array, as host numpy
+    ``[len(local_ranks()), ...]`` — the read-side inverse of
+    :func:`to_rank_major_global` (single process: the full array)."""
+
+    def leaf(a):
+        if isinstance(a, jax.Array) and not a.is_fully_addressable:
+            shards = a.addressable_shards
+            if a.ndim == 0 or all(
+                s.index == () or s.index[0].start is None for s in shards
+            ):
+                # replicated (or 0-d) leaf: every shard IS the value —
+                # concatenating would silently duplicate it per device
+                return np.asarray(shards[0].data)
+            by_start = {s.index[0].start: s for s in shards}
+            ordered = [by_start[k] for k in sorted(by_start)]
+            return np.concatenate(
+                [np.asarray(s.data) for s in ordered], axis=0
+            )
+        return np.asarray(a)
+
+    return jax.tree_util.tree_map(leaf, x)

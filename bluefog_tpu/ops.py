@@ -153,7 +153,9 @@ def _rank_major(fn, *, out_specs=P(NODES_AXIS)):
 
 
 def _as_tree(x):
-    return jax.tree_util.tree_map(jnp.asarray, x)
+    # single-process: a plain transfer; multi-process: assembles a global
+    # rank-major array from process-local rows (basics.to_rank_major_global)
+    return basics.to_rank_major_global(x)
 
 
 # --------------------------------------------------------------------------
@@ -208,16 +210,15 @@ def allgather(x, name: Optional[str] = None):
         def spmd(t):
             def per_leaf(a):
                 g = jax.lax.all_gather(a, NODES_AXIS, axis=0, tiled=True)
-                return g[None]  # leading rank axis for rank-major out_specs
+                # leading rank axis for rank-major out_specs; concatenate the
+                # gathered per-rank blocks INSIDE the traced fn (an eager
+                # reshape would reject non-addressable multi-host arrays)
+                return g.reshape((1, g.shape[0] * g.shape[1]) + g.shape[2:])
 
             return jax.tree_util.tree_map(per_leaf, t)
 
         f = _jit_cached(("allgather",), lambda: _rank_major(spmd))
-        out = f(_as_tree(x))
-        return jax.tree_util.tree_map(
-            lambda a: a.reshape((a.shape[0], a.shape[1] * a.shape[2]) + a.shape[3:]),
-            out,
-        )
+        return f(_as_tree(x))
 
 
 def allgather_nonblocking(x, name: Optional[str] = None) -> Handle:
@@ -379,16 +380,19 @@ def neighbor_allgather(x, name: Optional[str] = None):
 
         def spmd(t):
             y = ops_spmd.neighbor_allgather(t, plan=plan, axis_name=NODES_AXIS)
-            return jax.tree_util.tree_map(lambda a: jnp.moveaxis(a, 1, 0), y)
+
+            def finish(a):
+                a = jnp.moveaxis(a, 1, 0)  # per-shard [1, D, n0, ...]
+                if plan.is_regular:
+                    # concatenate neighbor blocks INSIDE the traced fn
+                    # (same multi-host rule as allgather above)
+                    a = a.reshape((1, a.shape[1] * a.shape[2]) + a.shape[3:])
+                return a
+
+            return jax.tree_util.tree_map(finish, y)
 
         f = _jit_cached(("neighbor_allgather", plan), lambda: _rank_major(spmd))
-        out = f(_as_tree(x))
-        if plan.is_regular:
-            return jax.tree_util.tree_map(
-                lambda a: a.reshape((a.shape[0], a.shape[1] * a.shape[2]) + a.shape[3:]),
-                out,
-            )
-        return out
+        return f(_as_tree(x))
 
 
 def neighbor_allgather_nonblocking(x, name: Optional[str] = None) -> Handle:

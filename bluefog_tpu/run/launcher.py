@@ -105,11 +105,59 @@ def main(argv=None) -> int:
     env = build_env(args)
     if args.islands:
         return _run_islands(cmd, env, args.islands, args.job)
+    if args.np is not None and args.np > 1 and args.process_id is None:
+        # `-np N` with no explicit process id: WE are the process launcher
+        # (the reference's `bfrun -np N` execs mpirun which forks the ranks
+        # [U]; here each child is one jax.distributed process)
+        return _run_multiprocess(cmd, env, args.np, args.coordinator)
     try:
         os.execvpe(cmd[0], cmd, env)
     except FileNotFoundError:
         print(f"bftpu-run: command not found: {cmd[0]}", file=sys.stderr)
         return 127
+
+
+def _run_multiprocess(cmd, env, nprocs: int, coordinator: str | None) -> int:
+    """Spawn ``nprocs`` local jax.distributed processes (single-host
+    multi-process: the CPU-mesh integration mode, and one-host-many-
+    processes TPU debugging).  Real multi-host runs invoke bftpu-run once
+    per host with an explicit ``--process-id`` instead."""
+    import socket
+    import subprocess
+
+    if coordinator is None:
+        # pick a free port for the rendezvous on this host
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            coordinator = f"127.0.0.1:{s.getsockname()[1]}"
+    import time
+
+    procs = []
+    for r in range(nprocs):
+        child_env = dict(env)
+        child_env["JAX_COORDINATOR_ADDRESS"] = coordinator
+        child_env["JAX_NUM_PROCESSES"] = str(nprocs)
+        child_env["JAX_PROCESS_ID"] = str(r)
+        procs.append(subprocess.Popen(cmd, env=child_env))
+    code = 0
+    # poll ALL children: rank k can die while rank 0 blocks in the
+    # distributed rendezvous waiting for it — an in-order wait would only
+    # report the failure after jax's multi-minute init timeout
+    live = list(procs)
+    while live:
+        for p in list(live):
+            rc = p.poll()
+            if rc is None:
+                continue
+            live.remove(p)
+            if rc != 0 and code == 0:
+                code = rc
+                for q in procs:
+                    if q.poll() is None:
+                        q.terminate()
+        if live:
+            time.sleep(0.05)
+    return code
 
 
 def _run_islands(cmd, env, nranks: int, job: str | None) -> int:
