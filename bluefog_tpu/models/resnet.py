@@ -62,8 +62,25 @@ class BasicBlock(nn.Module):
         return nn.relu(y + residual)
 
 
+def space_to_depth(x, factor: int = 2):
+    """NHWC space-to-depth: ``[B, H, W, C] -> [B, H/f, W/f, f*f*C]``."""
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // factor, factor, w // factor, factor, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, h // factor, w // factor, factor * factor * c)
+
+
 class ResNet(nn.Module):
-    """Configurable ResNet; stage_sizes [3,4,6,3] + bottleneck = ResNet-50."""
+    """Configurable ResNet; stage_sizes [3,4,6,3] + bottleneck = ResNet-50.
+
+    ``stem="space_to_depth"`` replaces the 7x7/s2 input convolution with a
+    2x2 space-to-depth rearrangement followed by a 4x4/s1 convolution on the
+    12-channel result — mathematically an 8x8/s2 convolution (a superset of
+    the 7x7), the standard TPU formulation (MLPerf ResNet): a 3-channel
+    conv wastes the 128-wide MXU contraction, the s2d form feeds it 12
+    channels and runs ~4x faster with equivalent accuracy.  Default stays
+    the canonical "conv" stem.
+    """
 
     stage_sizes: Sequence[int]
     block_cls: ModuleDef
@@ -71,6 +88,7 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
     small_images: bool = False  # CIFAR-style stem (3x3, no initial pool)
+    stem: str = "conv"  # "conv" (canonical 7x7/s2) | "space_to_depth"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -85,6 +103,13 @@ class ResNet(nn.Module):
         x = x.astype(self.dtype)
         if self.small_images:
             x = conv(self.num_filters, (3, 3), name="conv_init")(x)
+        elif self.stem == "space_to_depth":
+            # pad (1,2)x(1,2) ≙ the 8x8/s2 SAME geometry on the full image
+            x = space_to_depth(x, 2)
+            x = conv(
+                self.num_filters, (4, 4), padding=((1, 2), (1, 2)),
+                name="conv_init",
+            )(x)
         else:
             x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
         x = norm(name="bn_init")(x)

@@ -54,6 +54,7 @@ def make_decentralized_train_step(
     has_batch_stats: bool = False,
     num_steps_per_communication: int = 1,
     donate: bool = True,
+    steps_per_call: int = 1,
 ):
     """Build ``(init_fn, step_fn)`` for decentralized training on ``mesh``.
 
@@ -65,6 +66,13 @@ def make_decentralized_train_step(
     The returned functions are jit-compiled once per shape; inside, each
     rank's loss/grad runs on its shard and the optimizer transform carries
     the gossip.
+
+    ``steps_per_call=k`` fuses k FULL training steps (forward, backward,
+    optimizer, gossip) into one compiled program; ``batch``/``labels`` then
+    carry a leading sub-step axis ``[k, ranks, B, ...]`` and the returned
+    loss/acc are the last sub-step's.  On platforms with a fixed per-dispatch
+    cost (the tunneled TPU measures ~3.5 ms/call) this amortizes it — ~8%
+    ResNet-50 throughput at k=2 — at the price of k× compile time.
     """
     axes = mesh.axis_names
     if set(axes) == {MACHINES_AXIS, LOCAL_AXIS}:
@@ -130,6 +138,31 @@ def make_decentralized_train_step(
             expand(acc),
         )
 
+    if steps_per_call > 1:
+        # k fused steps per dispatch: batch/labels gain a leading sub-step
+        # axis, consumed by a python-unrolled loop (lax.scan over a body
+        # this size has crashed remote-compile services; unroll is safe)
+        def body(params, batch_stats, opt_state, batch, labels):
+            for i in range(steps_per_call):
+                params, batch_stats, opt_state, loss, acc = local_step(
+                    params, batch_stats, opt_state, batch[i], labels[i]
+                )
+            return params, batch_stats, opt_state, loss, acc
+
+        data_spec = P(None, *spec)
+
+        def _check_substep_axis(batch):
+            lead = {a.shape[0] for a in jax.tree_util.tree_leaves(batch)}
+            if lead != {steps_per_call}:
+                raise ValueError(
+                    f"steps_per_call={steps_per_call} needs batch/labels "
+                    f"with a leading [{steps_per_call}] sub-step axis; got "
+                    f"leading dims {sorted(lead)}"
+                )
+    else:
+        body = local_step
+        data_spec = spec
+
     def _opt_state_spec(opt_state, example_leaf_count):
         del example_leaf_count
         return jax.tree_util.tree_map(
@@ -152,14 +185,18 @@ def make_decentralized_train_step(
     compiled = {}
 
     def step_fn(params, batch_stats, opt_state, batch, labels):
+        if steps_per_call > 1:
+            # a [ranks, B, ...] batch here would silently shard the RANK
+            # axis as the sub-step axis and train on wrong slices
+            _check_substep_axis((batch, labels))
         key = jax.tree_util.tree_structure(opt_state)
         if key not in compiled:
             os_spec = _opt_state_spec(opt_state, None)
             compiled[key] = jax.jit(
                 jax.shard_map(
-                    local_step,
+                    body,
                     mesh=mesh,
-                    in_specs=(spec, spec, os_spec, spec, spec),
+                    in_specs=(spec, spec, os_spec, data_spec, data_spec),
                     out_specs=(spec, spec, os_spec, spec, spec),
                 ),
                 donate_argnums=(0, 1, 2) if donate else (),

@@ -178,3 +178,47 @@ def test_vit_forward_and_decentralized_step():
         losses.append(float(np.asarray(loss).mean()))
     assert all(np.isfinite(losses)), losses
     assert losses[-1] < losses[0], losses
+
+
+def test_steps_per_call_fused_matches_sequential():
+    """k fused steps per dispatch (dispatch-cost amortization) must produce
+    EXACTLY the same trajectory as k sequential single-step calls."""
+    bf.set_topology(tu.RingGraph(SIZE))
+    ctx = basics.context()
+    rng = np.random.default_rng(3)
+    params0 = replicate_for_mesh(
+        _mlp_params(jax.random.PRNGKey(1)), SIZE
+    )
+    xb = jnp.asarray(rng.normal(size=(SIZE, 8, 8)).astype(np.float32))
+    yb = jnp.asarray(rng.integers(0, 4, size=(SIZE, 8)), jnp.int32)
+    x2 = jnp.asarray(rng.normal(size=(SIZE, 8, 8)).astype(np.float32))
+    y2 = jnp.asarray(rng.integers(0, 4, size=(SIZE, 8)), jnp.int32)
+
+    def make(spc):
+        return make_decentralized_train_step(
+            _mlp_apply, optax.sgd(0.1, momentum=0.9), ctx.mesh,
+            communication_type=CommunicationType.neighbor_allreduce,
+            plan=ctx.plan, donate=False, steps_per_call=spc,
+        )
+
+    init1, step1 = make(1)
+    os1 = init1(params0)
+    p, os_ = params0, os1
+    for b, l in ((xb, yb), (x2, y2)):
+        p, _, os_, loss_seq, _ = step1(p, None, os_, b, l)
+
+    init2, step2 = make(2)
+    os2 = init2(params0)
+    batch = jnp.stack([xb, x2])
+    labels = jnp.stack([yb, y2])
+    p2, _, os2, loss_fused, _ = step2(params0, None, os2, batch, labels)
+
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        ),
+        p, p2,
+    )
+    np.testing.assert_allclose(
+        np.asarray(loss_seq), np.asarray(loss_fused), rtol=1e-6
+    )
