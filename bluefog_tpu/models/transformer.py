@@ -159,6 +159,24 @@ class _DecoderBlock(nn.Module):
         return x
 
 
+class _ScannedDecoderBlock(nn.Module):
+    """nn.scan body adapter: carry = activations, no per-step outputs."""
+
+    num_heads: int
+    dff: int
+    dtype: Any
+    attention_fn: Optional[Callable] = None
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cls = nn.remat(_DecoderBlock) if self.remat else _DecoderBlock
+        x = cls(self.num_heads, self.dff, self.dtype, self.attention_fn)(
+            x, positions
+        )
+        return x, None
+
+
 class LlamaLM(nn.Module):
     """Llama-style decoder-only LM: RMSNorm, rotary, SwiGLU, no biases.
 
@@ -174,6 +192,8 @@ class LlamaLM(nn.Module):
     dff: int = 1376
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[Callable] = None
+    remat: bool = False  # rematerialize each block: activations O(layers·B·T·d) -> O(B·T·d)
+    scan_layers: bool = False  # lax.scan over stacked layers: O(1)-size HLO
 
     @nn.compact
     def __call__(self, input_ids, positions=None):
@@ -181,9 +201,27 @@ class LlamaLM(nn.Module):
         if positions is None:
             positions = jnp.arange(T)
         x = nn.Embed(self.vocab_size, self.hidden_size, dtype=self.dtype)(input_ids)
-        for _ in range(self.num_layers):
-            x = _DecoderBlock(
-                self.num_heads, self.dff, self.dtype, self.attention_fn
+        if self.scan_layers:
+            # params gain a leading [num_layers] axis; the compiled program
+            # contains ONE block body instead of num_layers copies — at 1B+
+            # scale the unrolled HLO overwhelms compile services
+            scan = nn.scan(
+                _ScannedDecoderBlock,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=self.num_layers,
+                in_axes=nn.broadcast,
+            )
+            x, _ = scan(
+                self.num_heads, self.dff, self.dtype, self.attention_fn,
+                self.remat,
             )(x, positions)
+        else:
+            # remat selection for the scan path lives in _ScannedDecoderBlock
+            block_cls = nn.remat(_DecoderBlock) if self.remat else _DecoderBlock
+            for _ in range(self.num_layers):
+                x = block_cls(
+                    self.num_heads, self.dff, self.dtype, self.attention_fn
+                )(x, positions)
         x = RMSNorm(dtype=jnp.float32)(x)
         return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32)(x)

@@ -53,17 +53,33 @@ _HDR = struct.Struct("<iiiiqd")  # op, win_id, slot, mode, nbytes, p
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+    # preallocate + recv_into: a `buf += chunk` loop would copy O(n²/chunk)
+    # bytes (measured 20x slowdown on multi-MB window payloads)
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
             raise ConnectionError("peer closed")
-        buf += chunk
-    return buf
+        got += r
+    return buf  # bytearray: frombuffer/slice-assign/decode all accept it
 
 
 def _send_msg(sock, op, win_id=0, slot=0, mode=0, p=0.0, payload=b""):
-    sock.sendall(_HDR.pack(op, win_id, slot, mode, len(payload), p) + payload)
+    hdr = _HDR.pack(op, win_id, slot, mode, len(payload), p)
+    if not payload:
+        sock.sendall(hdr)
+        return
+    # scatter-gather: no header+payload concat copy; finish partial sends
+    # with zero-copy memoryview slices
+    sent = sock.sendmsg([hdr, memoryview(payload)])
+    hl = len(hdr)
+    if sent < hl:
+        sock.sendall(memoryview(hdr)[sent:])
+        sent = hl
+    if sent < hl + len(payload):
+        sock.sendall(memoryview(payload)[sent - hl:])
 
 
 def _recv_msg(sock):
@@ -463,9 +479,15 @@ class TcpShmWindow:
                     s.p = float(p)
                 s.version += 1
             return
+        try:
+            # zero-copy byte view; the uint8 reinterpret also covers
+            # ml_dtypes (bf16) arrays whose native buffers can't export
+            payload = a.view(np.uint8).data
+        except (TypeError, ValueError):
+            payload = a.tobytes()
         self.rt.peers.request(
             dst, _OP_WRITE, self._id, slot, 1 if accumulate else 0,
-            float(p), a.tobytes(),
+            float(p), payload,
         )
 
     def read_exposed(self, src: int):

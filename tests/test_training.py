@@ -222,3 +222,34 @@ def test_steps_per_call_fused_matches_sequential():
     np.testing.assert_allclose(
         np.asarray(loss_seq), np.asarray(loss_fused), rtol=1e-6
     )
+
+
+def test_llama_scan_layers_matches_unrolled():
+    """scan_layers=True (one block body in the HLO, params stacked on a
+    leading layer axis) must compute the same function as the unrolled
+    model when fed the same weights."""
+    from bluefog_tpu.models.transformer import LlamaLM
+
+    kw = dict(vocab_size=97, hidden_size=32, num_layers=3, num_heads=4,
+              dff=64, dtype=jnp.float32)
+    ids = jnp.ones((2, 8), jnp.int32)
+    m_un = LlamaLM(**kw)
+    m_sc = LlamaLM(**kw, scan_layers=True, remat=True)
+    p_un = m_un.init(jax.random.PRNGKey(0), ids)["params"]
+    p_sc = m_sc.init(jax.random.PRNGKey(0), ids)["params"]
+    blocks = sorted(
+        (k for k in p_un if k.startswith("_DecoderBlock")),
+        key=lambda s: int(s.split("_")[-1]),
+    )
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *[p_un[b] for b in blocks]
+    )
+    scan_key = next(k for k in p_sc if "Scan" in k)
+    inner_key = next(iter(p_sc[scan_key]))
+    p_sc2 = {k: p_un[k] for k in p_un if not k.startswith("_DecoderBlock")}
+    p_sc2[scan_key] = {inner_key: stacked}
+    out_un = m_un.apply({"params": p_un}, ids)
+    out_sc = m_sc.apply({"params": p_sc2}, ids)
+    np.testing.assert_allclose(
+        np.asarray(out_un), np.asarray(out_sc), atol=2e-6
+    )
