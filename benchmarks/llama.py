@@ -138,12 +138,18 @@ def main():
         t_ar = timed(CommunicationType.allreduce, None)
 
     toks = B * T / t_dec
+    # MFU convention (PaLM et al.): 6N flops/token fwd+bwd, NOT counting
+    # remat recompute (that would be HFU); vs the v5e's measured 99 TFLOP/s
+    # bf16 peak.  Attention flops excluded (standard approximation), so
+    # this slightly understates true utilization.
+    flops_per_tok = 6 * float(n_params)
     out = {
         "metric": f"Llama-{args.preset} ({n_params/1e6:.0f}M) tokens/sec/chip "
                   f"(neighbor_allreduce exp2, S={T})",
         "value": round(toks, 1),
         "unit": "tok/s/chip",
         "vs_baseline": round(t_ar / t_dec, 4),
+        "mfu_vs_99tf_bf16": round(toks * flops_per_tok / 99e12, 3),
     }
     stats = getattr(jax.local_devices()[0], "memory_stats", lambda: None)()
     if stats and stats.get("peak_bytes_in_use"):

@@ -72,6 +72,14 @@ def main():
         default="neighbor_allreduce",
         choices=["neighbor_allreduce", "allreduce", "hierarchical", "empty"],
     )
+    parser.add_argument(
+        "--loader",
+        default="python",
+        choices=["python", "native"],
+        help="native: write the dataset to a packed binary file and stream "
+        "it through the C++ prefetching loader (data_loader.cc) — the "
+        "end-to-end file input pipeline; python: in-memory numpy batches",
+    )
     args = parser.parse_args()
 
     bf.init()
@@ -124,32 +132,83 @@ def main():
 
     steps_per_epoch = per_rank // args.batch_size
     rng = np.random.default_rng(1)
-    for epoch in range(args.epochs):
-        perm = rng.permutation(per_rank)
-        loss = acc_tr = None
-        for s in range(steps_per_epoch):
-            idx = perm[s * args.batch_size : (s + 1) * args.batch_size]
-            bx = jnp.asarray(xtr[:, idx])
-            by = jnp.asarray(ytr[:, idx])
-            params, bs_rank_major, state, loss, acc_tr = step_fn(
-                params, bs_rank_major, state, bx, by
+
+    loader = None
+    loader_path = None
+    perms = None
+    try:
+        if args.loader == "native":
+            # Real file input pipeline: every (epoch, step) batch is packed
+            # as a fixed-size f32 record [n, B, 784+1] (pixels + label) in
+            # one binary file; C++ pread workers (data_loader.cc) prefetch
+            # records into a host ring ahead of the training loop.
+            import tempfile
+
+            from bluefog_tpu.native.data_native import NativeDataLoader
+
+            B = args.batch_size
+            tmp = tempfile.NamedTemporaryFile(
+                prefix="bf_mnist_", suffix=".bin", delete=False
             )
-        jax.block_until_ready(params)
-        # evaluate rank 0's model on the test set
-        logits = model.apply(
-            {"params": jax.tree_util.tree_map(lambda a: a[0], params)},
-            jnp.asarray(xte),
-        )
-        acc = float(jnp.mean(jnp.argmax(logits, -1) == jnp.asarray(yte)))
-        spread = max(
-            float(np.asarray(l).std(axis=0).max())
-            for l in jax.tree_util.tree_leaves(params)
-        )
-        print(
-            f"epoch {epoch + 1}: test acc (rank0) {acc:.4f}, "
-            f"train loss {float(np.asarray(loss).mean()):.4f}, "
-            f"param consensus spread {spread:.2e}"
-        )
+            loader_path = tmp.name
+            with tmp as f:
+                for _ in range(args.epochs):
+                    perm = rng.permutation(per_rank)
+                    for s in range(steps_per_epoch):
+                        idx = perm[s * B : (s + 1) * B]
+                        bx = xtr[:, idx].reshape(n, B, -1)
+                        by = ytr[:, idx].astype(np.float32)[..., None]
+                        f.write(
+                            np.concatenate([bx, by], axis=2)
+                            .astype(np.float32).tobytes()
+                        )
+            # workers=1 => records arrive in written (epoch, step) order
+            loader = NativeDataLoader(
+                (n, B, 28 * 28 + 1), depth=4, workers=1, path=loader_path
+            )
+        else:
+            perms = [rng.permutation(per_rank) for _ in range(args.epochs)]
+
+        def next_batch(epoch, s):
+            if loader is not None:
+                rec = loader.next()
+                bx = rec[..., :-1].reshape(n, args.batch_size, 28, 28, 1)
+                by = rec[..., -1].astype(np.int32)
+                return jnp.asarray(bx), jnp.asarray(by)
+            idx = perms[epoch][s * args.batch_size : (s + 1) * args.batch_size]
+            return jnp.asarray(xtr[:, idx]), jnp.asarray(ytr[:, idx])
+
+        for epoch in range(args.epochs):
+            loss = acc_tr = None
+            for s in range(steps_per_epoch):
+                bx, by = next_batch(epoch, s)
+                params, bs_rank_major, state, loss, acc_tr = step_fn(
+                    params, bs_rank_major, state, bx, by
+                )
+            jax.block_until_ready(params)
+            # evaluate rank 0's model on the test set
+            logits = model.apply(
+                {"params": jax.tree_util.tree_map(lambda a: a[0], params)},
+                jnp.asarray(xte),
+            )
+            acc = float(jnp.mean(jnp.argmax(logits, -1) == jnp.asarray(yte)))
+            spread = max(
+                float(np.asarray(l).std(axis=0).max())
+                for l in jax.tree_util.tree_leaves(params)
+            )
+            print(
+                f"epoch {epoch + 1}: test acc (rank0) {acc:.4f}, "
+                f"train loss {float(np.asarray(loss).mean()):.4f}, "
+                f"param consensus spread {spread:.2e}"
+            )
+    finally:
+        if loader is not None:
+            loader.close()
+        if loader_path is not None:
+            try:
+                os.unlink(loader_path)
+            except OSError:
+                pass
     bf.shutdown()
 
 

@@ -86,3 +86,24 @@ def test_torch_interop_conversion_helpers():
     assert a.shape == (3, 4)
     back = to_torch(a)
     np.testing.assert_allclose(back.numpy(), t.numpy(), rtol=1e-6)
+
+
+def test_save_restore_scanned_llama_params(tmp_path):
+    """Orbax round-trip of scan-stacked transformer params (the 1B-model
+    layout: leaves carry a leading [num_layers] axis) — the checkpoint path
+    must survive the layout BASELINE config #5 actually trains with."""
+    from bluefog_tpu.models.transformer import LlamaLM
+
+    m = LlamaLM(vocab_size=64, hidden_size=16, num_layers=3, num_heads=4,
+                dff=32, scan_layers=True, remat=True)
+    ids = jnp.ones((2, 8), jnp.int32)
+    p = m.init(jax.random.PRNGKey(0), ids)["params"]
+    ckpt.save(tmp_path / "ck", p)
+    restored = ckpt.restore(tmp_path / "ck")
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        p, restored,
+    )
+    out0 = m.apply({"params": p}, ids)
+    out1 = m.apply({"params": restored}, ids)
+    np.testing.assert_allclose(np.asarray(out0), np.asarray(out1))

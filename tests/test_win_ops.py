@@ -239,3 +239,24 @@ def test_win_put_refreshes_exposure_for_win_get():
                         neighbor_weights=[{(r - 1) % SIZE: 1.0} for r in range(SIZE)])
     expected = np.array([(r - 1) % SIZE + 100.0 for r in range(SIZE)])
     np.testing.assert_allclose(np.asarray(out)[:, 0], expected, rtol=1e-6)
+
+
+@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float16, jnp.float32])
+def test_win_put_update_dtype_matrix(dtype):
+    """Window gossip across the floating dtype matrix (the reference runs
+    its win-op tests per dtype, SURVEY §4): values AND output dtype."""
+    bf.set_topology(tu.RingGraph(SIZE))
+    x = jnp.broadcast_to(
+        jnp.arange(SIZE, dtype=dtype).reshape(SIZE, 1), (SIZE, 3)
+    )
+    bf.win_create(x, "wdt")
+    bf.win_put(x, "wdt")
+    out = bf.win_update("wdt")
+    assert out.dtype == dtype
+    W = tu.GetWeightMatrix(tu.RingGraph(SIZE))
+    expected = W @ np.arange(SIZE, dtype=np.float64)
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float64)[:, 0], expected,
+        rtol=3e-2 if dtype != jnp.float32 else 1e-5,
+    )
+    bf.win_free("wdt")
