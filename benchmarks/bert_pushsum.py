@@ -79,9 +79,12 @@ def main():
     )
 
     # Leaf fusion (the reference's tensor-fusion buffer, BLUEFOG_FUSION_
-    # THRESHOLD [U]): one packed window instead of ~200 per-leaf windows —
-    # the eager dispatch overhead (~3.5 ms/call on the tunneled chip) would
-    # otherwise dwarf the compute (measured 780 tok/s unfused vs packed).
+    # THRESHOLD [U]): the whole parameter tree rides one packed window.
+    # Same-session A/B on the chip: ~200 per-leaf windows 780 tok/s; the
+    # pytree window API (win_create(params, ...), auto pack/unpack) 16.4k;
+    # this hand-packed flow 25.5k — it keeps the value packed through the
+    # debias step instead of unpacking/repacking the 437 MB tree each
+    # round, which is the remaining delta.
     flat0, treedef = jax.tree_util.tree_flatten(params)
     shapes = [a.shape[1:] for a in flat0]
     sizes = [int(np.prod(s, dtype=np.int64)) for s in shapes]

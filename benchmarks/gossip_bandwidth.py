@@ -92,9 +92,9 @@ def measure_islands(nprocs: int, mb: float, iters: int, warmup: int,
     total_bytes = sum(b for b, _ in res)
     max_dt = max(dt for _, dt in res)
     gbs = total_bytes / max_dt / 1e9
-    # mirror shm_native's dispatch rule: anything but "tcp" runs over shm
-    env = os.environ.get("BLUEFOG_ISLAND_TRANSPORT", "").lower()
-    transport = "tcp" if env == "tcp" else "shm"
+    from bluefog_tpu.native.shm_native import island_transport
+
+    transport = island_transport()
     return {
         "metric": f"island win_put {transport}-mailbox bandwidth ({topology}, "
                   f"{nprocs} processes, {mb:g} MB payload)",

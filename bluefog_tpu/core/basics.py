@@ -162,6 +162,8 @@ class BlueFogContext:
         self.topology: Optional[nx.DiGraph] = None
         self.machine_topology: Optional[nx.DiGraph] = None
         self.windows: Dict[str, object] = {}  # name -> windows._Window
+        # name -> pack/unpack metadata for pytree (fused) windows
+        self.win_fusion: Dict[str, object] = {}
         self.win_associated_p_enabled = False
         self.set_topology(
             topology

@@ -481,16 +481,24 @@ def _derived_coord(job: str) -> str:
     return f"127.0.0.1:{port}"
 
 
-def _tcp_coord(job: str) -> Optional[str]:
-    """Coordinator address when the TCP (cross-host) transport is selected:
-    ``BLUEFOG_ISLAND_COORD=host:port`` selects it outright;
-    ``BLUEFOG_ISLAND_TRANSPORT=tcp`` derives a job-deterministic localhost
-    port (single-host testing)."""
+def island_transport() -> str:
+    """The transport the island runtime will actually use for the current
+    environment: "tcp" when ``BLUEFOG_ISLAND_COORD`` or
+    ``BLUEFOG_ISLAND_TRANSPORT=tcp`` selects it, else "shm".  The single
+    source of truth — benchmarks/labels must query this rather than
+    re-reading the env vars."""
     if os.environ.get("BLUEFOG_ISLAND_COORD"):
-        return _derived_coord(job)
+        return "tcp"
     if os.environ.get("BLUEFOG_ISLAND_TRANSPORT", "").lower() == "tcp":
-        return _derived_coord(job)
-    return None
+        return "tcp"
+    return "shm"
+
+
+def _tcp_coord(job: str) -> Optional[str]:
+    """Coordinator address when the TCP (cross-host) transport is selected
+    (see :func:`island_transport`): a job-deterministic localhost port for
+    single-host testing, or derived from ``BLUEFOG_ISLAND_COORD``."""
+    return _derived_coord(job) if island_transport() == "tcp" else None
 
 
 def unlink_segment(job: str, suffix: str) -> None:

@@ -279,11 +279,161 @@ def _exchange(
 # --------------------------------------------------------------------------
 
 
-def win_create(tensor, name: str, zero_init: bool = False) -> bool:
-    """Collectively create a named window from a rank-major tensor
-    (reference ``bf.win_create(tensor, name, zero_init)`` [U]).  The window's
-    neighbor structure snapshots the currently-installed topology."""
+class _FusionMeta:
+    """Pack/unpack metadata for a pytree (fused) window: the reference's
+    tensor-fusion buffer (``BLUEFOG_FUSION_THRESHOLD`` [U]) as an API-level
+    feature — a whole parameter tree rides ONE window, so each gossip round
+    is one exchange instead of one per leaf (measured 27x on BERT-base
+    through the tunnel's per-dispatch cost; `benchmarks/bert_pushsum.py`)."""
+
+    __slots__ = ("treedef", "shapes", "sizes")
+
+    def __init__(self, treedef, shapes, sizes):
+        self.treedef = treedef
+        self.shapes = shapes
+        self.sizes = sizes
+
+
+def _fusion_split(tensor):
+    """(meta, packed) for a pytree input; (None, tensor) for a bare array."""
+    leaves, treedef = jax.tree_util.tree_flatten(tensor)
+    if treedef == jax.tree_util.tree_structure(0) or all(
+        np.ndim(l) == 0 for l in leaves
+    ):
+        # bare array — including nested-list/scalar-leaf spellings that
+        # jnp.asarray accepts as one array
+        return None, jnp.asarray(tensor)
+    if not leaves:
+        raise ValueError("win_create: empty pytree")
     ctx = _ctx()
+    dts = {jnp.asarray(l).dtype for l in leaves}
+    if len(dts) > 1:
+        raise ValueError(
+            f"fused windows need a uniform leaf dtype, got {sorted(map(str, dts))}; "
+            "create one window per dtype group (cf. islands.DistributedWinPutOptimizer)"
+        )
+    bad = [tuple(np.shape(l)) for l in leaves
+           if np.ndim(l) == 0 or np.shape(l)[0] != ctx.size]
+    if bad:
+        raise ValueError(
+            f"every fused-window leaf must be rank-major with leading dim "
+            f"{ctx.size}; offending leaf shapes: {bad[:4]}"
+        )
+    n = ctx.size
+    shapes = [tuple(np.shape(l)[1:]) for l in leaves]
+    sizes = [int(np.prod(s, dtype=np.int64)) for s in shapes]
+    meta = _FusionMeta(treedef, shapes, sizes)
+    return meta, _fusion_pack(meta, leaves, n)
+
+
+def _pack_leaves(meta, leaves, n, dtype=None):
+    """Traceable pack body — the ONE place the packed layout is defined."""
+    ls = [l.astype(dtype) if dtype is not None else l for l in leaves]
+    return jnp.concatenate([l.reshape(n, -1) for l in ls], axis=1)
+
+
+def _unpack_leaves(meta, packed, n):
+    """Traceable unpack body (inverse of :func:`_pack_leaves`)."""
+    out, off = [], 0
+    for s, sz in zip(meta.shapes, meta.sizes):
+        out.append(packed[:, off:off + sz].reshape((n,) + s))
+        off += sz
+    return out
+
+
+def _fusion_pack(meta, leaves, n):
+    # ONE compiled program per tree structure: eagerly this is ~2 dispatches
+    # per leaf, which on dispatch-expensive platforms costs more than the
+    # gossip itself (measured 15x on BERT-base through the tunnel)
+    f = _ctx().jit_cache(
+        ("win_fusion_pack", meta.treedef, tuple(meta.shapes), n),
+        lambda: jax.jit(lambda ls: _pack_leaves(meta, ls, n)),
+    )
+    return f([jnp.asarray(l) for l in leaves])
+
+
+def _fusion_pack_tree(meta, tree, n):
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if treedef != meta.treedef:
+        raise ValueError(
+            f"pytree structure does not match the window's: {treedef} vs "
+            f"{meta.treedef}"
+        )
+    return _fusion_pack(meta, leaves, n)
+
+
+def _fusion_unpack(meta, packed):
+    n = packed.shape[0]
+    f = _ctx().jit_cache(
+        ("win_fusion_unpack", meta.treedef, tuple(meta.shapes), n),
+        lambda: jax.jit(lambda p: _unpack_leaves(meta, p, n)),
+    )
+    return jax.tree_util.tree_unflatten(meta.treedef, f(packed))
+
+
+def _pack_input(name, tensor):
+    """Pack a pytree op input when ``name`` is a fused window."""
+    meta = _ctx().win_fusion.get(name)
+    if meta is None:
+        return tensor
+    return _fusion_pack_tree(meta, tensor, _ctx().size)
+
+
+def _fused_exchange(win, name, meta, tree, scales, active, accumulate):
+    """Pack + exchange in ONE compiled program (fused windows): leaves go
+    in, the packed exposure comes back alongside the new mailbox state —
+    a separate eager pack would cost an extra dispatch per gossip round."""
+    ctx = _ctx()
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if treedef != meta.treedef:
+        raise ValueError(
+            f"pytree structure does not match the window's: {treedef} vs "
+            f"{meta.treedef}"
+        )
+    with_p = ctx.win_associated_p_enabled
+    n = ctx.size
+    key = ("win_fused_exchange", meta.treedef, tuple(meta.shapes), win.plan,
+           accumulate, with_p, win.dtype)
+
+    def build():
+        inner = _build_exchange(win.plan, accumulate, with_p)
+
+        def f(ls, mail, versions, p_self, p_mail, scales, active):
+            x = _pack_leaves(meta, ls, n, dtype=win.dtype)
+            mail, versions, p_mail = inner(
+                x, mail, versions, p_self, p_mail, scales, active
+            )
+            return x, mail, versions, p_mail
+
+        return jax.jit(f)
+
+    f = ctx.jit_cache(key, build)
+    x, mail, versions, p_mail = f(
+        leaves, win.mail, win.versions, win.p_self, win.p_mail,
+        jnp.asarray(scales), jnp.asarray(active),
+    )
+    win.self_tensor = x
+    win.mail, win.versions = mail, versions
+    if with_p:
+        win.p_mail = p_mail
+
+
+def _unpack_output(name, packed):
+    meta = _ctx().win_fusion.get(name)
+    if meta is None:
+        return packed
+    return _fusion_unpack(meta, packed)
+
+
+def win_create(tensor, name: str, zero_init: bool = False) -> bool:
+    """Collectively create a named window from a rank-major tensor — or a
+    whole rank-major PYTREE, which is fused into one packed window (every
+    subsequent op on ``name`` then accepts/returns the same tree structure)
+    (reference ``bf.win_create(tensor, name, zero_init)`` [U]; the pytree
+    form subsumes its fusion buffer).  The window's neighbor structure
+    snapshots the currently-installed topology."""
+    ctx = _ctx()
+    meta, tensor = _fusion_split(tensor)
     t = jnp.asarray(tensor)
     if t.shape[0] != ctx.size:
         raise ValueError(
@@ -292,6 +442,8 @@ def win_create(tensor, name: str, zero_init: bool = False) -> bool:
     if name in ctx.windows:
         return False
     ctx.windows[name] = _Window(name, t, ctx.plan, zero_init)
+    if meta is not None:
+        ctx.win_fusion[name] = meta
     return True
 
 
@@ -300,7 +452,9 @@ def win_free(name: Optional[str] = None) -> bool:
     ctx = _ctx()
     if name is None:
         ctx.windows.clear()
+        ctx.win_fusion.clear()
         return True
+    ctx.win_fusion.pop(name, None)
     return ctx.windows.pop(name, None) is not None
 
 
@@ -314,9 +468,14 @@ def win_put(tensor, name: str, dst_weights: WeightsArg = None) -> bool:
     """
     with timeline_context("win_put"):
         win = _win(name)
-        win.self_tensor = jnp.asarray(tensor, dtype=win.dtype)
         scales, active = _class_scales(win.plan, dst_weights, side="send")
-        _exchange(win, tensor, scales, active, accumulate=False)
+        meta = _ctx().win_fusion.get(name)
+        if meta is not None:
+            _fused_exchange(win, name, meta, tensor, scales, active,
+                            accumulate=False)
+        else:
+            win.self_tensor = jnp.asarray(tensor, dtype=win.dtype)
+            _exchange(win, tensor, scales, active, accumulate=False)
     return True
 
 
@@ -332,9 +491,14 @@ def win_accumulate(tensor, name: str, dst_weights: WeightsArg = None) -> bool:
     ``bf.win_accumulate`` — MPI_Accumulate path [U])."""
     with timeline_context("win_accumulate"):
         win = _win(name)
-        win.self_tensor = jnp.asarray(tensor, dtype=win.dtype)
         scales, active = _class_scales(win.plan, dst_weights, side="send")
-        _exchange(win, tensor, scales, active, accumulate=True)
+        meta = _ctx().win_fusion.get(name)
+        if meta is not None:
+            _fused_exchange(win, name, meta, tensor, scales, active,
+                            accumulate=True)
+        else:
+            win.self_tensor = jnp.asarray(tensor, dtype=win.dtype)
+            _exchange(win, tensor, scales, active, accumulate=True)
     return True
 
 
@@ -437,27 +601,65 @@ def win_update(
         wmat, swvec = _update_weights(win, self_weight, neighbor_weights)
         wdt = win.dtype if jnp.issubdtype(win.dtype, jnp.inexact) else jnp.float32
         with_p = ctx.win_associated_p_enabled
+        meta = ctx.win_fusion.get(name)
         # one fused kernel per (shape, dtype, with_p); weights are traced
-        # args so every weight value shares the compile
-        key = ("win_update", with_p, win.dtype, win.shape[1:], maxd)
-        f = ctx.jit_cache(
-            key, lambda: jax.jit(_combine, static_argnames=("wdt", "with_p"))
-        )
-        combined, p_self = f(
-            win.self_tensor,
-            win.mail,
-            win.p_self,
-            win.p_mail,
-            jnp.asarray(wmat),
-            jnp.asarray(swvec),
-            wdt=wdt,
-            with_p=with_p,
-        )
+        # args so every weight value shares the compile.  Fused (pytree)
+        # windows get the unpack INSIDE the same program — a separate eager
+        # unpack would cost an extra dispatch per round.
+        if meta is None:
+            key = ("win_update", with_p, win.dtype, win.shape[1:], maxd)
+            f = ctx.jit_cache(
+                key,
+                lambda: jax.jit(_combine, static_argnames=("wdt", "with_p")),
+            )
+        else:
+            key = ("win_update_fused", with_p, win.dtype, win.shape[1:],
+                   maxd, meta.treedef, tuple(meta.shapes))
+
+            def build():
+                n = ctx.size
+
+                def f(self_t, mail, p_self, p_mail, wmat, swvec):
+                    combined, p_new = _combine(
+                        self_t, mail, p_self, p_mail, wmat, swvec,
+                        wdt=wdt, with_p=with_p,
+                    )
+                    return combined, p_new, _unpack_leaves(meta, combined, n)
+
+                return jax.jit(f)
+
+            f = ctx.jit_cache(key, build)
+        if meta is None:
+            combined, p_self = f(
+                win.self_tensor,
+                win.mail,
+                win.p_self,
+                win.p_mail,
+                jnp.asarray(wmat),
+                jnp.asarray(swvec),
+                wdt=wdt,
+                with_p=with_p,
+            )
+            leaves = None
+        else:
+            combined, p_self, leaves = f(
+                win.self_tensor,
+                win.mail,
+                win.p_self,
+                win.p_mail,
+                jnp.asarray(wmat),
+                jnp.asarray(swvec),
+            )
         win.self_tensor = combined
         if with_p:
             win.p_self = p_self
         if reset:
             _reset_mailbox(win)
+        if meta is not None:
+            tree = jax.tree_util.tree_unflatten(meta.treedef, leaves)
+            if clone:
+                tree = jax.tree_util.tree_map(jnp.array, tree)
+            return tree
         out = win.self_tensor
         return jnp.array(out) if clone else out
 
@@ -483,7 +685,17 @@ def win_put_update(
     with timeline_context("win_put_update"):
         ctx = _ctx()
         win = _win(name)
-        t = jnp.asarray(tensor, dtype=win.dtype)
+        meta = ctx.win_fusion.get(name)
+        if meta is not None:
+            leaves, treedef = jax.tree_util.tree_flatten(tensor)
+            if treedef != meta.treedef:
+                raise ValueError(
+                    f"pytree structure does not match the window's: "
+                    f"{treedef} vs {meta.treedef}"
+                )
+            t = leaves  # packed inside the compiled program below
+        else:
+            t = jnp.asarray(tensor, dtype=win.dtype)
         if dst_weights is None and self_weight is None and neighbor_weights is None:
             # the optimizer hot path: the four weight arrays are constant
             # per window, so build + upload them once
@@ -504,20 +716,39 @@ def win_put_update(
         with_p = ctx.win_associated_p_enabled
         wdt = win.dtype if jnp.issubdtype(win.dtype, jnp.inexact) else jnp.float32
         key = ("win_put_update", win.plan, accumulate, with_p, win.dtype,
-               win.shape[1:])
-        f = ctx.jit_cache(
-            key, lambda: _build_put_update(win.plan, accumulate, with_p, wdt)
-        )
-        combined, mail, versions, p_mail, p_self = f(
+               win.shape[1:],
+               None if meta is None else (meta.treedef, tuple(meta.shapes)))
+
+        def build():
+            inner = _build_put_update(win.plan, accumulate, with_p, wdt)
+            if meta is None:
+                return inner
+            n = ctx.size
+
+            def f(ls, mail, versions, p_self, p_mail, sc, ac, wm, sw):
+                x = _pack_leaves(meta, ls, n, dtype=win.dtype)
+                combined, mail, versions, p_mail, p_self = inner(
+                    x, mail, versions, p_self, p_mail, sc, ac, wm, sw
+                )
+                return (combined, mail, versions, p_mail, p_self,
+                        _unpack_leaves(meta, combined, n))
+
+            return jax.jit(f)
+
+        f = ctx.jit_cache(key, build)
+        out = f(
             t, win.mail, win.versions, win.p_self, win.p_mail,
             scales_d, active_d, wmat_d, swvec_d,
         )
+        combined, mail, versions, p_mail, p_self = out[:5]
         win.self_tensor = combined
         win.mail, win.versions = mail, versions
         if with_p:
             win.p_mail, win.p_self = p_mail, p_self
         if reset:
             _reset_mailbox(win)
+        if meta is not None:
+            return jax.tree_util.tree_unflatten(meta.treedef, out[5])
         return combined
 
 
@@ -576,7 +807,7 @@ def win_set_exposed(name: str, tensor, associated_p=None) -> None:
     gets this for free because its windows alias the torch tensor [U]; the
     mailbox emulation needs an explicit setter."""
     win = _win(name)
-    t = jnp.asarray(tensor, dtype=win.dtype)
+    t = jnp.asarray(_pack_input(name, tensor), dtype=win.dtype)
     if t.shape != win.shape:
         raise ValueError(f"shape {t.shape} != window shape {win.shape}")
     win.self_tensor = t

@@ -3,6 +3,7 @@
 update/mutex semantics + multi-step convergence-to-consensus with
 tolerances)."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -260,3 +261,71 @@ def test_win_put_update_dtype_matrix(dtype):
         rtol=3e-2 if dtype != jnp.float32 else 1e-5,
     )
     bf.win_free("wdt")
+
+
+def test_fused_pytree_window_gossip():
+    """win_create on a PYTREE fuses it into one packed window (the
+    reference's fusion buffer as API); ops accept and return the tree."""
+    bf.set_topology(tu.RingGraph(SIZE))
+    tree = {
+        "w": rank_tensor((3, 2)),
+        "b": rank_tensor((5,)),
+    }
+    assert bf.win_create(tree, "fused")
+    bf.win_put(tree, "fused")
+    out = bf.win_update("fused")
+    assert set(out.keys()) == {"w", "b"}
+    assert out["w"].shape == (SIZE, 3, 2)
+    assert out["b"].shape == (SIZE, 5)
+    W = tu.GetWeightMatrix(tu.RingGraph(SIZE))
+    expected = W @ np.arange(SIZE, dtype=np.float64)
+    for leaf in (out["w"][:, 0, 0], out["b"][:, 0]):
+        np.testing.assert_allclose(np.asarray(leaf), expected, rtol=1e-5)
+
+    # fused matches per-leaf windows exactly
+    bf.win_create(tree["w"], "solo")
+    bf.win_put(tree["w"], "solo")
+    solo = bf.win_update("solo")
+    np.testing.assert_allclose(np.asarray(out["w"]), np.asarray(solo), rtol=1e-6)
+
+    # win_put_update fused path too
+    merged = bf.win_put_update(out, "fused")
+    assert merged["w"].shape == (SIZE, 3, 2)
+    bf.win_free("fused")
+    bf.win_free("solo")
+
+
+def test_fused_window_structure_and_dtype_errors():
+    tree = {"a": rank_tensor((2,)), "b": rank_tensor((2,))}
+    bf.win_create(tree, "f2")
+    with pytest.raises(ValueError):
+        bf.win_put({"a": rank_tensor((2,))}, "f2")  # wrong structure
+    bf.win_free("f2")
+    mixed = {"a": rank_tensor((2,)),
+             "b": jnp.zeros((SIZE, 2), jnp.bfloat16)}
+    with pytest.raises(ValueError):
+        bf.win_create(mixed, "f3")  # mixed dtypes
+
+
+def test_fused_window_push_sum_associated_p():
+    """Push-sum debias loop through a fused window (the BERT bench path)."""
+    bf.set_topology(tu.RingGraph(SIZE, connect_style=1))
+    bf.turn_on_win_ops_with_associated_p()
+    tree = {"x": rank_tensor((4,)), "y": rank_tensor((2, 2))}
+    bf.win_create(tree, "ps", zero_init=True)
+    vals = tree
+    for _ in range(120):  # directed-ring mixing rate ~0.92/iter
+        dst = [{(r + 1) % SIZE: 0.5} for r in range(SIZE)]
+        bf.win_accumulate(vals, "ps", dst_weights=dst)
+        ones_prev = [{(r - 1) % SIZE: 1.0} for r in range(SIZE)]
+        m = bf.win_update("ps", self_weight=0.5, neighbor_weights=ones_prev,
+                          reset=True)
+        p = bf.win_associated_p("ps")
+        vals = jax.tree_util.tree_map(
+            lambda a: a / p.reshape((SIZE,) + (1,) * (a.ndim - 1)), m
+        )
+        bf.win_set_exposed("ps", vals, associated_p=1.0)
+    mean = (SIZE - 1) / 2.0
+    for leaf in jax.tree_util.tree_leaves(vals):
+        np.testing.assert_allclose(np.asarray(leaf), mean, atol=1e-3)
+    bf.win_free("ps")
