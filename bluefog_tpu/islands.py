@@ -124,6 +124,7 @@ class _IslandContext:
             if size_ > 1 else _trivial_graph()
         self.windows: Dict[str, _IslandWindow] = {}
         self.created_names: set = set()  # for shm unlink at shutdown
+        self.win_fusion: Dict[str, object] = {}  # name -> pytree pack meta
         self.associated_p = False
         self.shm_job = shm_native.make_job(job, rank_, size_)
 
@@ -277,15 +278,97 @@ def _to_host(tensor) -> np.ndarray:
     return np.asarray(tensor)
 
 
+class _IslandFusionMeta:
+    """Pytree (fused) window metadata — one packed buffer per tree, the
+    twin of windows._FusionMeta for the island (numpy/host) runtime."""
+
+    __slots__ = ("treedef", "shapes", "sizes")
+
+    def __init__(self, treedef, shapes, sizes):
+        self.treedef = treedef
+        self.shapes = shapes
+        self.sizes = sizes
+
+
+def _island_fusion_split(tensor):
+    """(meta, packed 1-D array) for a pytree; (None, tensor) for an array."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tensor)
+    if treedef == jax.tree_util.tree_structure(0):
+        return None, tensor
+    if not leaves:
+        raise ValueError("win_create: empty pytree")
+    if isinstance(tensor, (list, tuple)) and all(
+        np.ndim(l) == 0 for l in leaves
+    ):
+        # nested-list-of-scalars spelling of a bare array
+        return None, np.asarray(tensor)
+    hosts = [_to_host(l) for l in leaves]
+    dts = {h.dtype for h in hosts}
+    if len(dts) > 1:
+        raise ValueError(
+            f"fused windows need a uniform leaf dtype, got "
+            f"{sorted(map(str, dts))}; create one window per dtype group"
+        )
+    meta = _IslandFusionMeta(
+        treedef,
+        [h.shape for h in hosts],
+        [int(h.size) for h in hosts],
+    )
+    return meta, np.concatenate([h.ravel() for h in hosts])
+
+
+def _island_pack(name, tensor):
+    meta = _ctx().win_fusion.get(name)
+    if meta is None:
+        return tensor
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tensor)
+    if treedef == jax.tree_util.tree_structure(0):
+        # already-packed array (internal callers like push_sum_round work
+        # on the packed buffer) — accept iff it has the packed length
+        t = _to_host(tensor)
+        if t.shape == (sum(meta.sizes),):
+            return t
+        raise ValueError(
+            f"window '{name}' is a fused pytree window; pass the tree "
+            f"(or its packed [{sum(meta.sizes)}] buffer), got shape {t.shape}"
+        )
+    if treedef != meta.treedef:
+        raise ValueError(
+            f"pytree structure does not match window '{name}': {treedef} "
+            f"vs {meta.treedef}"
+        )
+    return np.concatenate([_to_host(l).ravel() for l in leaves])
+
+
+def _island_unpack(name, packed):
+    meta = _ctx().win_fusion.get(name)
+    if meta is None:
+        return packed
+    import jax
+
+    out, off = [], 0
+    for s, sz in zip(meta.shapes, meta.sizes):
+        out.append(packed[off:off + sz].reshape(s))
+        off += sz
+    return jax.tree_util.tree_unflatten(meta.treedef, out)
+
+
 def win_create(tensor, name: str, zero_init: bool = False) -> bool:
     """Collectively create a named window from THIS rank's tensor
     (reference ``bf.win_create`` [U]; collective like MPI_Win_create)."""
     ctx = _ctx()
     if name in ctx.windows:
         return False
+    meta, tensor = _island_fusion_split(tensor)
     t = _to_host(tensor)
     ctx.windows[name] = _IslandWindow(name, t, ctx, zero_init)
     ctx.created_names.add(name)
+    if meta is not None:
+        ctx.win_fusion[name] = meta
     return True
 
 
@@ -310,6 +393,7 @@ def win_free(name: Optional[str] = None) -> bool:
         w.shm.unlink_segments()
         ctx.shm_job.barrier()  # name gone everywhere before any re-create
         ctx.created_names.discard(n)
+        ctx.win_fusion.pop(n, None)
     return ok
 
 
@@ -321,7 +405,7 @@ def win_put(tensor, name: str, dst_weights: WeightDict = None) -> bool:
     with timeline_context("island_win_put"):
         ctx = _ctx()
         win = _win(name)
-        t = _to_host(tensor).astype(win.shm.dtype, copy=False)
+        t = _to_host(_island_pack(name, tensor)).astype(win.shm.dtype, copy=False)
         # alias, don't copy: upstream the window aliases the user tensor's
         # memory, and the shm exposure below is already a stable snapshot
         win.self_tensor = t
@@ -343,7 +427,7 @@ def win_accumulate(tensor, name: str, dst_weights: WeightDict = None) -> bool:
     with timeline_context("island_win_accumulate"):
         ctx = _ctx()
         win = _win(name)
-        t = _to_host(tensor).astype(win.shm.dtype, copy=False)
+        t = _to_host(_island_pack(name, tensor)).astype(win.shm.dtype, copy=False)
         targets = _check_dst(win, dst_weights)
         for d in targets:
             wgt = 1.0 if dst_weights is None else float(dst_weights[d])
@@ -426,7 +510,8 @@ def win_update(
             win.p_self = float(p_acc)
         win.shm.expose(win.self_tensor, win.p_self)
         out = win.self_tensor
-        return np.array(out, copy=True) if clone else out
+        out = np.array(out, copy=True) if clone else out
+        return _island_unpack(name, out)
 
 
 def win_update_then_collect(name: str, require_mutex: bool = False) -> np.ndarray:
@@ -442,10 +527,10 @@ def win_update_then_collect(name: str, require_mutex: bool = False) -> np.ndarra
                           reset=True)
 
 
-def win_sync(name: str) -> np.ndarray:
-    """My current tensor without combining (reference ``bf.win_sync``-style
-    read of the window copy [U])."""
-    return _win(name).self_tensor
+def win_sync(name: str):
+    """My current tensor (or pytree, for fused windows) without combining
+    (reference ``bf.win_sync``-style read of the window copy [U])."""
+    return _island_unpack(name, _win(name).self_tensor)
 
 
 @contextlib.contextmanager
@@ -480,7 +565,7 @@ def win_set_exposed(name: str, tensor, associated_p: Optional[float] = None) -> 
     """Overwrite my exposed tensor (and optionally p) without a put — the
     push-sum debias-and-restart idiom (see windows.win_set_exposed)."""
     win = _win(name)
-    t = _to_host(tensor).astype(win.shm.dtype, copy=False)
+    t = _to_host(_island_pack(name, tensor)).astype(win.shm.dtype, copy=False)
     if t.shape != win.shm.shape:
         raise ValueError(f"shape {t.shape} != window shape {win.shm.shape}")
     win.self_tensor = t  # alias (reference windows alias the tensor [U])

@@ -483,10 +483,14 @@ def _derived_coord(job: str) -> str:
 
 def island_transport() -> str:
     """The transport the island runtime will actually use for the current
-    environment: "tcp" when ``BLUEFOG_ISLAND_COORD`` or
-    ``BLUEFOG_ISLAND_TRANSPORT=tcp`` selects it, else "shm".  The single
-    source of truth — benchmarks/labels must query this rather than
-    re-reading the env vars."""
+    environment, mirroring ``make_job``/``make_window`` dispatch exactly:
+    "routed" (hierarchical shm-intra/TCP-inter) when
+    ``BLUEFOG_ISLAND_HOSTMAP`` is set, else "tcp" when
+    ``BLUEFOG_ISLAND_COORD`` or ``BLUEFOG_ISLAND_TRANSPORT=tcp`` selects
+    it, else "shm".  The single source of truth — benchmarks/labels must
+    query this rather than re-reading the env vars."""
+    if os.environ.get("BLUEFOG_ISLAND_HOSTMAP"):
+        return "routed"
     if os.environ.get("BLUEFOG_ISLAND_COORD"):
         return "tcp"
     if os.environ.get("BLUEFOG_ISLAND_TRANSPORT", "").lower() == "tcp":

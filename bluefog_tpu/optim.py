@@ -347,22 +347,6 @@ class DistributedGradientAllreduceOptimizer(_EagerDistributedOptimizer):
         return gradient_allreduce_spmd(self.base, NODES_AXIS, self.k)
 
 
-def _pack_leaves(leaves):
-    """Rank-major leaves [size, ...] -> one [size, total_elems] buffer."""
-    size = leaves[0].shape[0]
-    return jnp.concatenate([l.reshape(size, -1) for l in leaves], axis=1)
-
-
-def _unpack_leaves(buf, *, shapes):
-    """Inverse of :func:`_pack_leaves` for the given leaf shapes."""
-    sizes = [int(np.prod(s[1:])) for s in shapes]
-    offsets = np.cumsum([0] + sizes)
-    return [
-        buf[:, offsets[i]:offsets[i + 1]].reshape(shapes[i])
-        for i in range(len(shapes))
-    ]
-
-
 class DistributedWinPutOptimizer:
     """Asynchronous win-put optimizer (reference
     ``bf.DistributedWinPutOptimizer`` [U]): each step does a local adapt,
@@ -403,10 +387,13 @@ class DistributedWinPutOptimizer:
             for g, (_, idxs) in enumerate(
                 sorted(by_dtype.items(), key=lambda kv: str(kv[0]))
             ):
-                shapes = tuple(tuple(leaves[i].shape) for i in idxs)
-                packed = _pack_leaves([leaves[i] for i in idxs])
-                windows.win_create(packed, f"{self.prefix}.fused{g}")
-                self._groups.append((idxs, shapes))
+                # a LIST of leaves is a pytree: windows fuses it into one
+                # packed window and packs/unpacks inside the compiled
+                # exchange programs (no separate pack dispatches here)
+                windows.win_create(
+                    [leaves[i] for i in idxs], f"{self.prefix}.fused{g}"
+                )
+                self._groups.append(idxs)
         else:
             for i, leaf in enumerate(leaves):
                 windows.win_create(leaf, f"{self.prefix}.{i}")
@@ -439,20 +426,10 @@ class DistributedWinPutOptimizer:
         if self._step_count % self.k == 0:
             flat, treedef = jax.tree_util.tree_flatten(adapted)
             if self.fuse:
-                for g, (idxs, shapes) in enumerate(self._groups):
+                for g, idxs in enumerate(self._groups):
                     name = f"{self.prefix}.fused{g}"
-                    pack = ctx.jit_cache(
-                        ("winput_pack", shapes),
-                        lambda: jax.jit(_pack_leaves),
-                    )
-                    unpack = ctx.jit_cache(
-                        ("winput_unpack", shapes),
-                        lambda shapes=shapes: jax.jit(
-                            functools.partial(_unpack_leaves, shapes=shapes)
-                        ),
-                    )
-                    parts = unpack(
-                        windows.win_put_update(pack([flat[i] for i in idxs]), name)
+                    parts = windows.win_put_update(
+                        [flat[i] for i in idxs], name
                     )
                     for i, part in zip(idxs, parts):
                         flat[i] = part

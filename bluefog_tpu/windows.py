@@ -297,14 +297,15 @@ class _FusionMeta:
 def _fusion_split(tensor):
     """(meta, packed) for a pytree input; (None, tensor) for a bare array."""
     leaves, treedef = jax.tree_util.tree_flatten(tensor)
-    if treedef == jax.tree_util.tree_structure(0) or all(
-        np.ndim(l) == 0 for l in leaves
-    ):
-        # bare array — including nested-list/scalar-leaf spellings that
-        # jnp.asarray accepts as one array
+    if treedef == jax.tree_util.tree_structure(0):
         return None, jnp.asarray(tensor)
     if not leaves:
         raise ValueError("win_create: empty pytree")
+    if isinstance(tensor, (list, tuple)) and all(
+        np.ndim(l) == 0 for l in leaves
+    ):
+        # nested-list-of-scalars spelling of a bare array
+        return None, jnp.asarray(tensor)
     ctx = _ctx()
     dts = {jnp.asarray(l).dtype for l in leaves}
     if len(dts) > 1:
@@ -362,15 +363,6 @@ def _fusion_pack_tree(meta, tree, n):
     return _fusion_pack(meta, leaves, n)
 
 
-def _fusion_unpack(meta, packed):
-    n = packed.shape[0]
-    f = _ctx().jit_cache(
-        ("win_fusion_unpack", meta.treedef, tuple(meta.shapes), n),
-        lambda: jax.jit(lambda p: _unpack_leaves(meta, p, n)),
-    )
-    return jax.tree_util.tree_unflatten(meta.treedef, f(packed))
-
-
 def _pack_input(name, tensor):
     """Pack a pytree op input when ``name`` is a fused window."""
     meta = _ctx().win_fusion.get(name)
@@ -416,13 +408,6 @@ def _fused_exchange(win, name, meta, tree, scales, active, accumulate):
     win.mail, win.versions = mail, versions
     if with_p:
         win.p_mail = p_mail
-
-
-def _unpack_output(name, packed):
-    meta = _ctx().win_fusion.get(name)
-    if meta is None:
-        return packed
-    return _fusion_unpack(meta, packed)
 
 
 def win_create(tensor, name: str, zero_init: bool = False) -> bool:

@@ -233,6 +233,37 @@ def test_island_fallback_transport_end_to_end(monkeypatch):
         np.testing.assert_allclose(res[r], expected[r], atol=1e-12)
 
 
+def _worker_fused_tree(rank, size):
+    islands.set_topology(topology_util.RingGraph(size))
+    tree = {
+        "w": np.full((2, 3), float(rank), np.float32),
+        "b": np.full((4,), float(rank), np.float32),
+    }
+    islands.win_create(tree, "ft")
+    islands.barrier()
+    islands.win_put(tree, "ft")
+    islands.barrier()
+    out = islands.win_update("ft")
+    islands.barrier()
+    sync = islands.win_sync("ft")
+    islands.win_free("ft")
+    return (out["w"][0, 0], out["b"][0],
+            sync["w"].shape, sync["b"].shape)
+
+
+def test_island_fused_pytree_window():
+    """Pytree (fused) windows in the island runtime: tree in, tree out,
+    gossip math identical to the per-array window."""
+    size = 4
+    res = islands.spawn(_worker_fused_tree, size, timeout=300)
+    W = topology_util.GetWeightMatrix(topology_util.RingGraph(size))
+    expected = W @ np.arange(size, dtype=np.float64)
+    for r, (w00, b0, wshape, bshape) in enumerate(res):
+        assert wshape == (2, 3) and bshape == (4,)
+        np.testing.assert_allclose(w00, expected[r], rtol=1e-6)
+        np.testing.assert_allclose(b0, expected[r], rtol=1e-6)
+
+
 def test_spawn_surfaces_child_failure():
     with pytest.raises(RuntimeError, match="island spawn failed"):
         islands.spawn(_worker_boom, 2, timeout=60.0)
