@@ -341,7 +341,16 @@ def _island_pack(name, tensor):
             f"pytree structure does not match window '{name}': {treedef} "
             f"vs {meta.treedef}"
         )
-    return np.concatenate([_to_host(l).ravel() for l in leaves])
+    hosts = [_to_host(l) for l in leaves]
+    bad = [(h.shape, tuple(exp)) for h, exp in zip(hosts, meta.shapes)
+           if h.shape != tuple(exp)]
+    if bad:
+        # same-size-different-shape leaves would pack without error and
+        # unpack as silently corrupted data
+        raise ValueError(
+            f"leaf shapes do not match window '{name}': {bad[:4]}"
+        )
+    return np.concatenate([h.ravel() for h in hosts])
 
 
 def _island_unpack(name, packed):
@@ -486,7 +495,7 @@ def win_update(
     neighbor_weights: WeightDict = None,
     reset: bool = False,
     clone: bool = False,
-) -> np.ndarray:
+):  # -> np.ndarray, or the window's pytree for fused windows
     """Local weighted combine of my exposed tensor with my mailbox slots
     (reference ``bf.win_update`` [U]; default uniform 1/(in_degree+1)).
     ``reset=True`` drains the slots atomically (collect) so in-flight
@@ -514,7 +523,8 @@ def win_update(
         return _island_unpack(name, out)
 
 
-def win_update_then_collect(name: str, require_mutex: bool = False) -> np.ndarray:
+def win_update_then_collect(name: str, require_mutex: bool = False):
+    # -> np.ndarray, or the window's pytree for fused windows
     """Self weight 1, every neighbor slot weight 1, atomic drain — the
     push-sum accumulate-and-drain idiom (reference
     ``bf.win_update_then_collect`` [U]).  ``require_mutex`` is honored with
@@ -585,7 +595,8 @@ def get_win_version(name: str) -> Dict[int, int]:
     }
 
 
-def push_sum_round(name: str, dst_weights: WeightDict = None) -> np.ndarray:
+def push_sum_round(name: str, dst_weights: WeightDict = None):
+    # -> np.ndarray, or the window's pytree for fused windows
     """One mass-conserving asynchronous push-sum round (Kempe et al.; the
     algorithm the reference's ``win_accumulate`` + associated-p machinery
     exists for — ``examples/pytorch_optimization.py`` push-sum loops [U]).

@@ -370,7 +370,7 @@ class DistributedWinPutOptimizer:
         self.fuse = fuse
         self._step_count = 0
         self._created = False
-        self._groups = None  # fused mode: [(leaf_indices, leaf_shapes)]
+        self._groups = None  # fused mode: [leaf_indices] per dtype group
 
     def init(self, params):
         leaves = jax.tree_util.tree_leaves(params)

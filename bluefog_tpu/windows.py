@@ -353,6 +353,16 @@ def _fusion_pack(meta, leaves, n):
     return f([jnp.asarray(l) for l in leaves])
 
 
+def _check_fused_leaves(meta, leaves, n):
+    bad = [(tuple(np.shape(l)), (n,) + tuple(exp))
+           for l, exp in zip(leaves, meta.shapes)
+           if tuple(np.shape(l)) != (n,) + tuple(exp)]
+    if bad:
+        # same-size-different-shape leaves would pack without error and
+        # unpack as silently corrupted data
+        raise ValueError(f"leaf shapes do not match the window's: {bad[:4]}")
+
+
 def _fusion_pack_tree(meta, tree, n):
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     if treedef != meta.treedef:
@@ -360,6 +370,7 @@ def _fusion_pack_tree(meta, tree, n):
             f"pytree structure does not match the window's: {treedef} vs "
             f"{meta.treedef}"
         )
+    _check_fused_leaves(meta, leaves, n)
     return _fusion_pack(meta, leaves, n)
 
 
@@ -382,6 +393,7 @@ def _fused_exchange(win, name, meta, tree, scales, active, accumulate):
             f"pytree structure does not match the window's: {treedef} vs "
             f"{meta.treedef}"
         )
+    _check_fused_leaves(meta, leaves, ctx.size)
     with_p = ctx.win_associated_p_enabled
     n = ctx.size
     key = ("win_fused_exchange", meta.treedef, tuple(meta.shapes), win.plan,
@@ -678,6 +690,7 @@ def win_put_update(
                     f"pytree structure does not match the window's: "
                     f"{treedef} vs {meta.treedef}"
                 )
+            _check_fused_leaves(meta, leaves, ctx.size)
             t = leaves  # packed inside the compiled program below
         else:
             t = jnp.asarray(tensor, dtype=win.dtype)
