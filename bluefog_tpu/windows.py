@@ -298,7 +298,7 @@ def _fusion_split(tensor):
     """(meta, packed) for a pytree input; (None, tensor) for a bare array."""
     leaves, treedef = jax.tree_util.tree_flatten(tensor)
     if treedef == jax.tree_util.tree_structure(0):
-        return None, jnp.asarray(tensor)
+        return None, basics.to_rank_major_global(tensor)
     if not leaves:
         raise ValueError("win_create: empty pytree")
     if isinstance(tensor, (list, tuple)) and all(
@@ -307,6 +307,11 @@ def _fusion_split(tensor):
         # nested-list-of-scalars spelling of a bare array
         return None, jnp.asarray(tensor)
     ctx = _ctx()
+    # multi-host: each leaf may arrive as this process's rank rows; the
+    # converter assembles global arrays (single process: plain asarray).
+    # One call — a list is a pytree, and per-leaf calls would redo the
+    # context/sharding setup per leaf.
+    leaves = basics.to_rank_major_global(leaves)
     dts = {jnp.asarray(l).dtype for l in leaves}
     if len(dts) > 1:
         raise ValueError(
@@ -430,6 +435,7 @@ def win_create(tensor, name: str, zero_init: bool = False) -> bool:
     form subsumes its fusion buffer).  The window's neighbor structure
     snapshots the currently-installed topology."""
     ctx = _ctx()
+    # _fusion_split performs the multi-host conversion for both forms
     meta, tensor = _fusion_split(tensor)
     t = jnp.asarray(tensor)
     if t.shape[0] != ctx.size:
@@ -465,6 +471,7 @@ def win_put(tensor, name: str, dst_weights: WeightsArg = None) -> bool:
     """
     with timeline_context("win_put"):
         win = _win(name)
+        tensor = basics.to_rank_major_global(tensor)
         scales, active = _class_scales(win.plan, dst_weights, side="send")
         meta = _ctx().win_fusion.get(name)
         if meta is not None:
@@ -488,6 +495,7 @@ def win_accumulate(tensor, name: str, dst_weights: WeightsArg = None) -> bool:
     ``bf.win_accumulate`` — MPI_Accumulate path [U])."""
     with timeline_context("win_accumulate"):
         win = _win(name)
+        tensor = basics.to_rank_major_global(tensor)
         scales, active = _class_scales(win.plan, dst_weights, side="send")
         meta = _ctx().win_fusion.get(name)
         if meta is not None:
@@ -682,6 +690,7 @@ def win_put_update(
     with timeline_context("win_put_update"):
         ctx = _ctx()
         win = _win(name)
+        tensor = basics.to_rank_major_global(tensor)
         meta = ctx.win_fusion.get(name)
         if meta is not None:
             leaves, treedef = jax.tree_util.tree_flatten(tensor)
@@ -805,6 +814,7 @@ def win_set_exposed(name: str, tensor, associated_p=None) -> None:
     gets this for free because its windows alias the torch tensor [U]; the
     mailbox emulation needs an explicit setter."""
     win = _win(name)
+    tensor = basics.to_rank_major_global(tensor)
     t = jnp.asarray(_pack_input(name, tensor), dtype=win.dtype)
     if t.shape != win.shape:
         raise ValueError(f"shape {t.shape} != window shape {win.shape}")

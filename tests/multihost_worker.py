@@ -76,6 +76,31 @@ def main():
         basics.local_slice(hout)[:, 0], 3.5, rtol=1e-5
     )
 
+    # --- window ops across processes (eager mailbox emulation) ------------
+    bf.win_create(x_local, "mh_win")
+    bf.win_put(x_local, "mh_win")
+    wout = bf.win_update("mh_win")
+    got_w = basics.local_slice(wout)
+    np.testing.assert_allclose(got_w[:, 0], expected, rtol=1e-5)
+    # fused pytree window from process-local rows
+    tree = {"a": x_local, "b": x_local[:, :2]}
+    bf.win_create(tree, "mh_tree")
+    bf.win_put(tree, "mh_tree")
+    tout = bf.win_update("mh_tree")
+    np.testing.assert_allclose(
+        basics.local_slice(tout["b"])[:, 0], expected, rtol=1e-5
+    )
+    # the optimizer hot path (fused put+update) and accumulate/set_exposed
+    # must also take process-local rows
+    pout = bf.win_put_update(tree, "mh_tree")
+    assert basics.local_slice(pout["a"]).shape == (4, 3)
+    bf.win_set_exposed("mh_tree", tree)
+    bf.win_accumulate(tree, "mh_tree")
+    aout = bf.win_update("mh_tree", reset=True)
+    assert np.isfinite(basics.local_slice(aout["a"])).all()
+    bf.win_free("mh_win")
+    bf.win_free("mh_tree")
+
     # --- one ATC train step on the global mesh ----------------------------
     import jax.numpy as jnp
     import optax
