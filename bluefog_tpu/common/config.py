@@ -5,7 +5,10 @@ vars (SURVEY.md §5.6: ``BLUEFOG_LOG_LEVEL``, ``BLUEFOG_TIMELINE``,
 ``BLUEFOG_FUSION_THRESHOLD``, ``BLUEFOG_CYCLE_TIME``).  We keep the same
 names.  Fusion/cycle knobs are accepted-but-inert: XLA fuses and schedules
 collectives itself, so they exist only so reference-era launch scripts do
-not break (a warning is logged when they are set to non-defaults).
+not break (a warning is logged when they are set to non-defaults).  The
+*capability* the fusion buffer provided — one exchange for many tensors —
+is an explicit API here instead of a byte threshold: pass a pytree to
+``win_create`` (one packed window) or use the fused optimizer modes.
 """
 
 from __future__ import annotations
