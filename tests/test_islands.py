@@ -179,7 +179,7 @@ def test_island_deterministic_suite():
     """Barriered diffusion matches the analytic W^k trajectory; win_get
     pull-combine matches the closed form; deposit versions count."""
     size, steps = 4, 7
-    res = islands.spawn(_worker_deterministic_suite, size, args=(steps,))
+    res = islands.spawn(_worker_deterministic_suite, size, args=(steps,), timeout=300.0)
     topo = topology_util.RingGraph(size)
     W = np.linalg.matrix_power(_weight_matrix(topo), steps)
     x0 = np.stack([np.arange(3, dtype=np.float64) + r for r in range(size)])
@@ -211,7 +211,7 @@ def test_island_async_pushsum_exact_average():
 
 def test_island_mutex_mutual_exclusion(tmp_path):
     path = str(tmp_path / "mutex.log")
-    islands.spawn(_worker_mutex, 2, args=(path,))
+    islands.spawn(_worker_mutex, 2, args=(path,), timeout=300.0)
     lines = open(path).read().splitlines()
     assert len(lines) == 2 * 2 * 25
     for i in range(0, len(lines), 2):
@@ -224,7 +224,7 @@ def test_island_mutex_mutual_exclusion(tmp_path):
 def test_island_fallback_transport_end_to_end(monkeypatch):
     monkeypatch.setenv("BLUEFOG_SHM_FALLBACK", "1")
     size, steps = 2, 4
-    res = islands.spawn(_worker_fallback_diffuse, size, args=(steps,))
+    res = islands.spawn(_worker_fallback_diffuse, size, args=(steps,), timeout=300.0)
     topo = topology_util.RingGraph(size)
     W = np.linalg.matrix_power(_weight_matrix(topo), steps)
     x0 = np.stack([np.arange(3, dtype=np.float64) + r for r in range(size)])
@@ -352,7 +352,7 @@ def _worker_recreate(rank, size):
 
 
 def test_island_recreate_after_free_is_fresh():
-    res = islands.spawn(_worker_recreate, 4)
+    res = islands.spawn(_worker_recreate, 4, timeout=300.0)
     for r in range(4):
         np.testing.assert_allclose(res[r], np.zeros(2), atol=0)
 
