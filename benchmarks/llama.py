@@ -139,9 +139,11 @@ def main():
 
     toks = B * T / t_dec
     # MFU convention (PaLM et al.): 6N flops/token fwd+bwd, NOT counting
-    # remat recompute (that would be HFU); vs the v5e's measured 99 TFLOP/s
-    # bf16 peak.  Attention flops excluded (standard approximation), so
-    # this slightly understates true utilization.
+    # remat recompute (that would be HFU); vs the v5e's 197 TFLOP/s bf16
+    # peak (measured 188-207 by dispatch-amortized slope, benchmarks/
+    # peaks.py — round 2's "99" was dispatch-contaminated).  Attention
+    # flops excluded (standard approximation), so this slightly
+    # understates true utilization.
     flops_per_tok = 6 * float(n_params)
     out = {
         "metric": f"Llama-{args.preset} ({n_params/1e6:.0f}M) tokens/sec/chip "
@@ -149,7 +151,7 @@ def main():
         "value": round(toks, 1),
         "unit": "tok/s/chip",
         "vs_baseline": round(t_ar / t_dec, 4),
-        "mfu_vs_99tf_bf16": round(toks * flops_per_tok / 99e12, 3),
+        "mfu_vs_197tf_bf16": round(toks * flops_per_tok / 197e12, 3),
     }
     stats = getattr(jax.local_devices()[0], "memory_stats", lambda: None)()
     if stats and stats.get("peak_bytes_in_use"):

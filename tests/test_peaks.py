@@ -1,0 +1,34 @@
+"""benchmarks/peaks.py: the dispatch-amortized peak-measurement harness.
+
+Values are hardware-dependent; these tests pin the harness contract —
+the slope protocol runs, returns the documented keys, and the traffic
+accounting constants are what the docstrings claim.
+"""
+
+import sys
+import os
+
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks"))
+
+import peaks  # noqa: E402
+
+
+def test_matmul_peak_returns_contract_keys():
+    out = peaks.matmul_peak(64, jnp.float32, k_lo=2, k_hi=6, n=1)
+    assert set(out) == {"tflops", "ms_per_matmul", "t_lo_s", "t_hi_s"}
+    # t_hi covers more iterations of the same program than t_lo
+    assert out["t_hi_s"] > 0 and out["t_lo_s"] > 0
+
+
+def test_hbm_stream_returns_contract_keys():
+    out = peaks.hbm_stream(mb=2, k_lo=2, k_hi=6, n=1)
+    assert set(out) == {"gbs", "ms_per_iter", "array_mb"}
+    assert out["array_mb"] == 2.0
+
+
+def test_dispatch_cost_runs():
+    out = peaks.dispatch_cost(n=2)
+    assert out["ms"] > 0
