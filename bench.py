@@ -141,56 +141,68 @@ def main():
         CommunicationType.neighbor_allreduce, model, ctx.mesh, ctx.plan,
         batch, labels, params, batch_stats, steps_per_call=spc,
     )
-    t_dec = time_steps(step_dec, params, batch_stats, os_dec, batch, labels, warmup, iters)
+    dec_times = [time_steps(
+        step_dec, params, batch_stats, os_dec, batch, labels, warmup, iters)]
 
     # global-allreduce baseline (the reference point).  On a single chip the
     # exp2 plan has no neighbors, so both phases run the same computation and
-    # the honest ratio is ~1; if the budget is spent, skip further timing
-    # rather than produce nothing.
-    if n == 1 and time.perf_counter() - t_start > budget_s:
-        t_ar = t_dec
-    else:
-        step_ar, os_ar = build(
-            CommunicationType.allreduce, model, ctx.mesh, None,
-            batch, labels, params, batch_stats, steps_per_call=spc,
-        )
-        t_ar = time_steps(
-            step_ar, params, batch_stats, os_ar, batch, labels, warmup, iters
-        )
-        # extra interleaved passes per phase (compiles cached, ~seconds
-        # each): taking mins cancels most machine-noise drift in the ratio
-        for _ in range(2):
-            if time.perf_counter() - t_start > budget_s:
-                break
-            t_dec = min(t_dec, time_steps(
-                step_dec, params, batch_stats, os_dec, batch, labels, 1, iters
-            ))
-            t_ar = min(t_ar, time_steps(
-                step_ar, params, batch_stats, os_ar, batch, labels, 1, iters
-            ))
+    # the honest ratio is ~1.
+    step_ar, os_ar = build(
+        CommunicationType.allreduce, model, ctx.mesh, None,
+        batch, labels, params, batch_stats, steps_per_call=spc,
+    )
+    ar_times = [time_steps(
+        step_ar, params, batch_stats, os_ar, batch, labels, warmup, iters)]
+    # UNCONDITIONAL interleaved min-of-3 per phase (round-2 verdict #3:
+    # budget-gating let machine-noise drift move the headline ±10%).
+    # Compiles are cached, so each extra pass is seconds; taking mins
+    # cancels drift, and the recorded spread says how trustworthy the
+    # round-over-round delta is.
+    for _ in range(2):
+        dec_times.append(time_steps(
+            step_dec, params, batch_stats, os_dec, batch, labels, 1, iters))
+        ar_times.append(time_steps(
+            step_ar, params, batch_stats, os_ar, batch, labels, 1, iters))
+    t_dec, t_ar = min(dec_times), min(ar_times)
+    # worst per-phase spread: noise in EITHER phase moves the ratio
+    spread_pct = max(
+        (max(dec_times) - t_dec) / t_dec,
+        (max(ar_times) - t_ar) / t_ar,
+    ) * 100
 
     imgs_per_sec_chip = per_rank_batch * spc / t_dec  # per-rank == per-chip
     ratio = t_ar / t_dec  # >1 means gossip step is faster than allreduce
 
-    # Second BASELINE.json tracked metric: win_put gossip bandwidth.  On one
-    # chip the SPMD exp2 plan has no edges, so the honest measurement is the
-    # TRUE one-sided path — island processes writing through the native shm
-    # mailbox.  Budget-guarded; a failure must not cost the headline metric.
-    bw = None
+    # Second BASELINE.json tracked metric: win_put gossip bandwidth —
+    # BOTH regimes, each with a real baseline (round-2 verdict #4):
+    #   - SPMD win_put_update wire bandwidth on the mesh (self-edge
+    #     loopback on 1 chip), vs the raw neighbor_allreduce collective;
+    #   - island 2-process shm win_put per-rank GB/s (the mailbox,
+    #     not the scheduler), vs the host's raw memcpy ceiling.
+    # Budget-guarded; a failure must not cost the headline metric.
+    bw_spmd = bw_isl = None
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "benchmarks"))
     if time.perf_counter() - t_start < budget_s:
         try:
-            sys.path.insert(0, os.path.join(os.path.dirname(
-                os.path.abspath(__file__)), "benchmarks"))
-            from gossip_bandwidth import measure_islands, measure_spmd
-            if n > 1:
-                bw = measure_spmd(mb=64.0, iters=10, warmup=2)
-            else:
-                bw = measure_islands(nprocs=8, mb=8.0, iters=10, warmup=2)
+            from gossip_bandwidth import measure_spmd
+            # 256 MB payload: the eager per-call overhead is ~10 ms on
+            # slow-RTT tunnel sessions, so small payloads measure the
+            # dispatch, not the wire
+            bw_spmd = measure_spmd(mb=256.0 if on_tpu else 4.0,
+                                   iters=10, warmup=2)
             # stderr: stdout carries exactly ONE JSON line (the contract);
             # the bw numbers ride in the headline line's extra keys
-            print(json.dumps(bw), file=sys.stderr)
+            print(json.dumps(bw_spmd), file=sys.stderr)
         except Exception as e:  # noqa: BLE001
-            print(f"gossip bandwidth phase failed: {e!r}", file=sys.stderr)
+            print(f"spmd bandwidth phase failed: {e!r}", file=sys.stderr)
+    if time.perf_counter() - t_start < budget_s:
+        try:
+            from gossip_bandwidth import measure_islands
+            bw_isl = measure_islands(nprocs=2, mb=16.0, iters=10, warmup=2)
+            print(json.dumps(bw_isl), file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"island bandwidth phase failed: {e!r}", file=sys.stderr)
 
     headline = {
         "metric": "ResNet-50 images/sec/chip (neighbor_allreduce exp2)"
@@ -199,11 +211,16 @@ def main():
         "value": round(imgs_per_sec_chip, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(ratio, 4),
+        "spread_pct": round(spread_pct, 2),
     }
-    if bw is not None:
-        # both tracked metrics ride in the one parsed line
-        headline["win_put_gossip_bandwidth_gbs"] = bw["value"]
-        headline["win_put_bandwidth_metric"] = bw["metric"]
+    if bw_spmd is not None:
+        headline["win_put_gossip_bandwidth_gbs"] = bw_spmd["value"]
+        headline["win_put_bandwidth_metric"] = bw_spmd["metric"]
+        headline["win_put_vs_neighbor_allreduce"] = bw_spmd["vs_baseline"]
+    if bw_isl is not None:
+        headline["island_win_put_gbs_per_rank"] = bw_isl["value"]
+        headline["island_win_put_metric"] = bw_isl["metric"]
+        headline["island_win_put_vs_raw_memcpy"] = bw_isl["vs_baseline"]
     print(json.dumps(headline))
 
 

@@ -506,15 +506,22 @@ def win_update(
         sw, nw = _resolve_update_weights(win, self_weight, neighbor_weights)
         wdt = (win.shm.dtype if np.issubdtype(win.shm.dtype, np.inexact)
                else np.float64)
-        acc = win.self_tensor.astype(wdt) * sw
+        # preallocated-scratch combine: the naive expression
+        # ``acc + w * a.astype(wdt)`` allocates three payload-sized
+        # temporaries per neighbor (astype ALWAYS copies), which dominates
+        # the gossip round on a 1-core host.  One fused multiply into a
+        # reused scratch buffer + in-place add keeps it to two passes.
+        acc = np.multiply(win.self_tensor, sw, dtype=wdt)
+        scratch = np.empty_like(acc)
         p_acc = sw * win.p_self
         for s in win.in_neighbors:
             a, p, _ = win.shm.read(
                 win.slot_of[ctx.rank][s], collect=reset, src=s
             )
-            acc = acc + nw[s] * a.astype(wdt)
+            np.multiply(a, nw[s], out=scratch, casting="unsafe")
+            acc += scratch
             p_acc = p_acc + nw[s] * p
-        win.self_tensor = acc.astype(win.shm.dtype)
+        win.self_tensor = acc.astype(win.shm.dtype, copy=False)
         if ctx.associated_p:
             win.p_self = float(p_acc)
         win.shm.expose(win.self_tensor, win.p_self)

@@ -44,6 +44,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from bluefog_tpu.common.logging_util import logger
 from bluefog_tpu.core import basics
 from bluefog_tpu.core.basics import NODES_AXIS
 from bluefog_tpu.core.plan import CommPlan
@@ -193,8 +194,13 @@ def _exchange_body(plan, accumulate, with_p, x, mail0, ver0, p_self, pm0,
     return mail0, ver0, pm0
 
 
-def _build_exchange(plan: CommPlan, accumulate: bool, with_p: bool):
-    """Jitted rank-major exchange (see :func:`_exchange_body`)."""
+def _build_exchange(plan: CommPlan, accumulate: bool, with_p: bool,
+                    donate: bool = True):
+    """Jitted rank-major exchange (see :func:`_exchange_body`).
+
+    ``donate=False`` when the result is called from inside another jit
+    (donation only applies at the outermost dispatch; the fused-window
+    wrappers donate on their own outer jit instead)."""
     ctx = _ctx()
 
     def spmd(x, mail, versions, p_self, p_mail, scales, active):
@@ -205,6 +211,9 @@ def _build_exchange(plan: CommPlan, accumulate: bool, with_p: bool):
         )
         return mail0[None], ver0[None], pm0[None]
 
+    # mail/versions/p_mail are returned and reassigned by every caller, so
+    # the input buffers are dead after the call: donating them lets XLA
+    # update in place instead of copying the full mailbox each exchange
     return jax.jit(
         jax.shard_map(
             spmd,
@@ -212,11 +221,13 @@ def _build_exchange(plan: CommPlan, accumulate: bool, with_p: bool):
             in_specs=(P(NODES_AXIS), P(NODES_AXIS), P(NODES_AXIS), P(NODES_AXIS),
                       P(NODES_AXIS), P(None, NODES_AXIS), P(None, NODES_AXIS)),
             out_specs=(P(NODES_AXIS), P(NODES_AXIS), P(NODES_AXIS)),
-        )
+        ),
+        donate_argnums=(1, 2, 4) if donate else (),
     )
 
 
-def _build_put_update(plan: CommPlan, accumulate: bool, with_p: bool, wdt):
+def _build_put_update(plan: CommPlan, accumulate: bool, with_p: bool, wdt,
+                      donate: bool = True):
     """One compiled program for put/accumulate + local weighted combine —
     the fused hot path of :func:`win_put_update` (one dispatch instead of
     an exchange jit plus a combine jit; XLA schedules the ppermute rounds
@@ -240,6 +251,9 @@ def _build_put_update(plan: CommPlan, accumulate: bool, with_p: bool, wdt):
         return (combined.astype(x.dtype)[None], mail0[None], ver0[None],
                 pm0[None], p_new[None])
 
+    # mail/versions/p_self/p_mail are returned and reassigned by
+    # win_put_update after every call (the input buffers are dead):
+    # donation lets XLA update the mailbox state in place
     return jax.jit(
         jax.shard_map(
             spmd,
@@ -249,7 +263,8 @@ def _build_put_update(plan: CommPlan, accumulate: bool, with_p: bool, wdt):
                       P(NODES_AXIS), P(NODES_AXIS)),
             out_specs=(P(NODES_AXIS), P(NODES_AXIS), P(NODES_AXIS),
                        P(NODES_AXIS), P(NODES_AXIS)),
-        )
+        ),
+        donate_argnums=(1, 2, 3, 4) if donate else (),
     )
 
 
@@ -261,7 +276,7 @@ def _exchange(
     key = ("win_exchange", win.plan, accumulate, with_p, win.dtype, win.shape[1:])
     f = ctx.jit_cache(key, lambda: _build_exchange(win.plan, accumulate, with_p))
     mail, versions, p_mail = f(
-        jnp.asarray(x, dtype=win.dtype),
+        _cast_to_window_dtype(win, win.name, x),
         win.mail,
         win.versions,
         win.p_self,
@@ -269,9 +284,9 @@ def _exchange(
         jnp.asarray(scales),
         jnp.asarray(active),
     )
-    win.mail, win.versions = mail, versions
-    if with_p:
-        win.p_mail = p_mail
+    # always reassign: the jit donated the old p_mail buffer, so the
+    # previous win.p_mail is invalid even when the p machinery is off
+    win.mail, win.versions, win.p_mail = mail, versions, p_mail
 
 
 # --------------------------------------------------------------------------
@@ -405,7 +420,7 @@ def _fused_exchange(win, name, meta, tree, scales, active, accumulate):
            accumulate, with_p, win.dtype)
 
     def build():
-        inner = _build_exchange(win.plan, accumulate, with_p)
+        inner = _build_exchange(win.plan, accumulate, with_p, donate=False)
 
         def f(ls, mail, versions, p_self, p_mail, scales, active):
             x = _pack_leaves(meta, ls, n, dtype=win.dtype)
@@ -414,7 +429,8 @@ def _fused_exchange(win, name, meta, tree, scales, active, accumulate):
             )
             return x, mail, versions, p_mail
 
-        return jax.jit(f)
+        # donate at the outermost jit (nested donation is ignored)
+        return jax.jit(f, donate_argnums=(1, 2, 4))
 
     f = ctx.jit_cache(key, build)
     x, mail, versions, p_mail = f(
@@ -422,9 +438,8 @@ def _fused_exchange(win, name, meta, tree, scales, active, accumulate):
         jnp.asarray(scales), jnp.asarray(active),
     )
     win.self_tensor = x
-    win.mail, win.versions = mail, versions
-    if with_p:
-        win.p_mail = p_mail
+    # always reassign (the old p_mail buffer was donated)
+    win.mail, win.versions, win.p_mail = mail, versions, p_mail
 
 
 def win_create(tensor, name: str, zero_init: bool = False) -> bool:
@@ -461,6 +476,25 @@ def win_free(name: Optional[str] = None) -> bool:
     return ctx.windows.pop(name, None) is not None
 
 
+def _cast_to_window_dtype(win, name, tensor):
+    """Eager cast with a CLEAR multi-process error.
+
+    In the multi-process non-fused path the input is a global
+    non-fully-addressable array; an eager ``convert_element_type`` on it
+    raises an opaque JAX error, so detect the case and name the fix
+    (the fused path avoids this by casting inside the compiled program).
+    """
+    t = jnp.asarray(tensor) if not isinstance(tensor, jax.Array) else tensor
+    if t.dtype != win.dtype and not getattr(t, "is_fully_addressable", True):
+        raise ValueError(
+            f"window '{name}' holds {win.dtype} but the input is {t.dtype}: "
+            "eager dtype casts on non-fully-addressable (multi-process "
+            "global) arrays are not supported — cast the input to the "
+            "window dtype before the call, or use a fused (pytree) window"
+        )
+    return jnp.asarray(t, dtype=win.dtype)
+
+
 def win_put(tensor, name: str, dst_weights: WeightsArg = None) -> bool:
     """Deposit (optionally dst-scaled) values into this rank's slot at each
     out-neighbor — only the ranks listed in ``dst_weights`` when given
@@ -478,16 +512,27 @@ def win_put(tensor, name: str, dst_weights: WeightsArg = None) -> bool:
             _fused_exchange(win, name, meta, tensor, scales, active,
                             accumulate=False)
         else:
-            win.self_tensor = jnp.asarray(tensor, dtype=win.dtype)
+            win.self_tensor = _cast_to_window_dtype(win, name, tensor)
             _exchange(win, tensor, scales, active, accumulate=False)
     return True
+
+
+@jax.jit
+def _completion_probe(mail):
+    """A tiny array data-dependent on ``mail``'s producing op — what a
+    nonblocking Handle holds.  The mailbox buffers themselves are DONATED
+    by the next window op on the same window, which would leave a Handle
+    holding a deleted array; the probe is a separate 1-element buffer that
+    becomes ready exactly when the exchange completes and is never
+    donated."""
+    return jnp.ravel(mail)[:1]
 
 
 def win_put_nonblocking(tensor, name: str, dst_weights: WeightsArg = None):
     from bluefog_tpu.ops import Handle
 
     win_put(tensor, name, dst_weights)
-    return Handle(_win(name).mail)
+    return Handle(_completion_probe(_win(name).mail))
 
 
 def win_accumulate(tensor, name: str, dst_weights: WeightsArg = None) -> bool:
@@ -502,7 +547,7 @@ def win_accumulate(tensor, name: str, dst_weights: WeightsArg = None) -> bool:
             _fused_exchange(win, name, meta, tensor, scales, active,
                             accumulate=True)
         else:
-            win.self_tensor = jnp.asarray(tensor, dtype=win.dtype)
+            win.self_tensor = _cast_to_window_dtype(win, name, tensor)
             _exchange(win, tensor, scales, active, accumulate=True)
     return True
 
@@ -511,7 +556,7 @@ def win_accumulate_nonblocking(tensor, name: str, dst_weights: WeightsArg = None
     from bluefog_tpu.ops import Handle
 
     win_accumulate(tensor, name, dst_weights)
-    return Handle(_win(name).mail)
+    return Handle(_completion_probe(_win(name).mail))
 
 
 def win_get(name: str, src_weights: WeightsArg = None) -> bool:
@@ -537,7 +582,7 @@ def win_get_nonblocking(name: str, src_weights: WeightsArg = None):
     from bluefog_tpu.ops import Handle
 
     win_get(name, src_weights)
-    return Handle(_win(name).mail)
+    return Handle(_completion_probe(_win(name).mail))
 
 
 def _reset_mailbox(win: _Window) -> None:
@@ -702,7 +747,7 @@ def win_put_update(
             _check_fused_leaves(meta, leaves, ctx.size)
             t = leaves  # packed inside the compiled program below
         else:
-            t = jnp.asarray(tensor, dtype=win.dtype)
+            t = _cast_to_window_dtype(win, name, tensor)
         if dst_weights is None and self_weight is None and neighbor_weights is None:
             # the optimizer hot path: the four weight arrays are constant
             # per window, so build + upload them once
@@ -727,9 +772,10 @@ def win_put_update(
                None if meta is None else (meta.treedef, tuple(meta.shapes)))
 
         def build():
-            inner = _build_put_update(win.plan, accumulate, with_p, wdt)
             if meta is None:
-                return inner
+                return _build_put_update(win.plan, accumulate, with_p, wdt)
+            inner = _build_put_update(win.plan, accumulate, with_p, wdt,
+                                      donate=False)
             n = ctx.size
 
             def f(ls, mail, versions, p_self, p_mail, sc, ac, wm, sw):
@@ -740,7 +786,8 @@ def win_put_update(
                 return (combined, mail, versions, p_mail, p_self,
                         _unpack_leaves(meta, combined, n))
 
-            return jax.jit(f)
+            # donate at the outermost jit (nested donation is ignored)
+            return jax.jit(f, donate_argnums=(1, 2, 3, 4))
 
         f = ctx.jit_cache(key, build)
         out = f(
@@ -750,8 +797,10 @@ def win_put_update(
         combined, mail, versions, p_mail, p_self = out[:5]
         win.self_tensor = combined
         win.mail, win.versions = mail, versions
-        if with_p:
-            win.p_mail, win.p_self = p_mail, p_self
+        # always reassign: the jit donates the old p buffers, so the
+        # previous win.p_mail/p_self are invalid even with with_p off
+        # (the returned values are passthroughs in that case)
+        win.p_mail, win.p_self = p_mail, p_self
         if reset:
             _reset_mailbox(win)
         if meta is not None:
@@ -762,8 +811,21 @@ def win_put_update(
 def win_update_then_collect(name: str, require_mutex: bool = False):
     """Collect-style update: self weight 1, every neighbor slot weight 1,
     then reset — the push-sum accumulate-and-drain idiom (reference
-    ``bf.win_update_then_collect`` [U])."""
-    del require_mutex
+    ``bf.win_update_then_collect`` [U]).
+
+    ``require_mutex`` is accepted for parity but has no effect HERE: under
+    the bulk-synchronous SPMD emulation the combine and drain execute in
+    one compiled program, so no concurrent writer can interleave
+    (staleness-0 — the mutex the reference takes is provably redundant).
+    The islands runtime, whose writers ARE concurrent, honors the flag
+    with a real cross-process mutex (``islands.win_update_then_collect``).
+    """
+    if require_mutex:
+        logger.debug(
+            "win_update_then_collect(require_mutex=True): no-op under the "
+            "bulk-synchronous emulation (atomic by construction); the "
+            "islands runtime takes a real mutex"
+        )
     ctx = _ctx()
     win = _win(name)
     ones = [
@@ -778,6 +840,8 @@ def win_wait(handle) -> bool:
 
 
 def win_poll(handle) -> bool:
+    """Reference ``bf.win_poll`` [U].  May block where the platform has no
+    async readiness query (see :meth:`bluefog_tpu.ops.Handle.poll`)."""
     return handle.poll()
 
 
@@ -803,8 +867,12 @@ def get_win_version(name: str) -> List[Dict[int, int]]:
 
 def win_associated_p(name: str) -> jnp.ndarray:
     """The push-sum associated scalar p per rank (reference
-    ``bf.win_associated_p`` [U])."""
-    return _win(name).p_self
+    ``bf.win_associated_p`` [U]).
+
+    Returns a COPY: the window's own p buffer is donated by the next
+    window op, so handing out the live reference would leave the caller
+    holding a deleted array."""
+    return jnp.array(_win(name).p_self)
 
 
 def win_set_exposed(name: str, tensor, associated_p=None) -> None:

@@ -329,3 +329,35 @@ def test_fused_window_push_sum_associated_p():
     for leaf in jax.tree_util.tree_leaves(vals):
         np.testing.assert_allclose(np.asarray(leaf), mean, atol=1e-3)
     bf.win_free("ps")
+
+
+def test_nonblocking_handle_survives_buffer_donation():
+    """The window programs donate the mailbox buffers; a Handle from a
+    nonblocking op must stay pollable/waitable after LATER ops on the same
+    window donate what it would naively hold (round-3 review finding)."""
+    bf.set_topology(tu.ExponentialTwoGraph(SIZE))
+    x = rank_tensor((4,))
+    bf.win_create(x, "hnb")
+    h1 = bf.win_put_nonblocking(x, "hnb")
+    bf.win_put(x + 1.0, "hnb")      # donates the mail buffer h1 was taken on
+    bf.win_update("hnb")
+    assert h1.poll() in (True, False)
+    h1.wait()                        # must not raise "Array has been deleted"
+    h2 = bf.win_accumulate_nonblocking(x, "hnb")
+    bf.win_put_update(x, "hnb")      # donates again (fused hot path)
+    h2.wait()
+    bf.win_free("hnb")
+
+
+def test_win_associated_p_copy_survives_donation():
+    bf.set_topology(tu.RingGraph(SIZE))
+    bf.turn_on_win_ops_with_associated_p()
+    try:
+        bf.win_create(rank_tensor((4,)), "pd")
+        bf.win_put(rank_tensor((4,)), "pd")
+        p = bf.win_associated_p("pd")
+        bf.win_put_update(rank_tensor((4,)), "pd")  # donates p_self
+        np.asarray(p)                # held copy must still be readable
+        bf.win_free("pd")
+    finally:
+        bf.turn_off_win_ops_with_associated_p()
