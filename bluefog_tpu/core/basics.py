@@ -118,7 +118,14 @@ def _machine_grid(
             )
         return np.array(rows)
 
-    slice_grid = group_by(lambda d: getattr(d, "slice_index", 0))
+    # normalize missing/None slice_index to a sortable int: a platform
+    # exposing slice_index=None on SOME devices and ints on others must
+    # not make sorted(groups) raise on mixed key types
+    def slice_key(d):
+        v = getattr(d, "slice_index", 0)
+        return -1 if v is None else int(v)
+
+    slice_grid = group_by(slice_key)
     if slice_grid is not None:
         return slice_grid
     proc_grid = group_by(lambda d: d.process_index)

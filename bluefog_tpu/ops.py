@@ -110,6 +110,14 @@ class Handle:
         self._value = value
 
     def poll(self) -> bool:
+        """True once the result buffers are materialized.
+
+        MAY BLOCK on platforms whose arrays lack an async ``is_ready``
+        query (e.g. the tunneled TPU plugin): there the only truthful
+        answer requires a ``device_sync`` round-trip, so a reference-style
+        "poll and do useful work meanwhile" loop degrades to a wait.  On
+        standard jax.Array platforms it is a non-blocking probe.
+        """
         leaves = jax.tree_util.tree_leaves(self._value)
         if all(hasattr(leaf, "is_ready") for leaf in leaves):
             return all(leaf.is_ready() for leaf in leaves)
@@ -125,7 +133,8 @@ class Handle:
 
 
 def poll(handle: Handle) -> bool:
-    """Reference ``bf.poll(handle)`` [U]."""
+    """Reference ``bf.poll(handle)`` [U].  May block where the platform
+    has no async readiness query (see :meth:`Handle.poll`)."""
     return handle.poll()
 
 

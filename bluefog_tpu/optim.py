@@ -390,13 +390,23 @@ class DistributedWinPutOptimizer:
                 # a LIST of leaves is a pytree: windows fuses it into one
                 # packed window and packs/unpacks inside the compiled
                 # exchange programs (no separate pack dispatches here)
-                windows.win_create(
+                if not windows.win_create(
                     [leaves[i] for i in idxs], f"{self.prefix}.fused{g}"
-                )
+                ):
+                    raise RuntimeError(
+                        f"window '{self.prefix}.fused{g}' already exists — "
+                        f"two optimizers share window_prefix={self.prefix!r}, "
+                        "or a prior instance was not win_free'd"
+                    )
                 self._groups.append(idxs)
         else:
             for i, leaf in enumerate(leaves):
-                windows.win_create(leaf, f"{self.prefix}.{i}")
+                if not windows.win_create(leaf, f"{self.prefix}.{i}"):
+                    raise RuntimeError(
+                        f"window '{self.prefix}.{i}' already exists — two "
+                        f"optimizers share window_prefix={self.prefix!r}, "
+                        "or a prior instance was not win_free'd"
+                    )
         self._created = True
         return self.base.init(params)
 

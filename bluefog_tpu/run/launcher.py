@@ -1,30 +1,48 @@
 """``bftpu-run`` — TPU-slice launcher, sibling of the reference's ``bfrun``.
 
 The reference's ``bfrun`` (``bluefog/run/run.py`` [U], SURVEY.md §3.5)
-assembles and execs an ``mpirun`` command: NIC probing, env forwarding,
-one process per rank.  On TPU pods the platform already provides the
-process-per-host convention and rendezvous (``jax.distributed.initialize``
-auto-configures from the TPU environment), so the launcher's job shrinks
-to: validate the environment, set Bluefog env vars, optionally configure a
-multi-process CPU simulation, and exec the training script.
+assembles and execs an ``mpirun`` command: host list parsing, NIC probing,
+env forwarding, one process per rank, ssh to remote hosts.  On TPU pods the
+platform already provides the process-per-host convention and rendezvous
+(``jax.distributed.initialize`` auto-configures from the TPU environment),
+so for the single-host cases the launcher's job shrinks to: validate the
+environment, set Bluefog env vars, optionally configure a multi-process CPU
+simulation, and exec the training script.  For multi-machine runs it does
+what ``bfrun -H`` does: spawn ranks on each listed host (ssh for remote
+hosts, fork for local ones), forward the env whitelist, and propagate the
+first failure to every sibling.
 
 Usage:
   bftpu-run python train.py                    # on a TPU host/pod worker
   bftpu-run --simulate 8 python train.py       # 8 virtual CPU devices
   bftpu-run -np 4 --coordinator host:port --process-id K python train.py
                                                # explicit multi-host bootstrap
+  bftpu-run -np 2 -H hostA:1,hostB:1 python train.py
+                                               # ssh-spawned multi-machine run
   bftpu-run --islands 4 python async_train.py  # N async island processes
-                                               # (bluefog_tpu.islands jobs —
-                                               # the ``mpirun -np N`` shape)
+  bftpu-run --islands 4 -H a:2,b:2 python async_train.py
+                                               # islands across machines
+                                               # (shm intra-host, TCP inter)
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import shlex
+import signal
+import socket
+import subprocess
 import sys
+import time
 
-__all__ = ["main", "build_env"]
+__all__ = ["main", "build_env", "parse_hosts", "ssh_command", "env_whitelist"]
+
+# Env forwarded to ssh-spawned ranks, by prefix (the reference forwards an
+# explicit whitelist plus every ``-x NAME``; prefixes cover our namespaced
+# config the same way).
+_FORWARD_PREFIXES = ("BLUEFOG_", "JAX_", "XLA_", "PYTHONPATH", "LIBTPU_",
+                     "TPU_")
 
 
 def build_env(args, base_env=None) -> dict:
@@ -51,6 +69,181 @@ def build_env(args, base_env=None) -> dict:
     return env
 
 
+def parse_hosts(spec: str) -> list:
+    """``"hostA:2,hostB:4"`` -> ``[("hostA", 2), ("hostB", 4)]`` (the
+    reference's ``-H``/``--hosts`` slot syntax [U]; a bare host means 1)."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, slots = part.partition(":")
+        if not host:
+            raise ValueError(f"bad -H entry {part!r}: empty host")
+        try:
+            n = int(slots) if slots else 1
+        except ValueError:
+            raise ValueError(f"bad -H entry {part!r}: slots must be an int")
+        if n < 1:
+            raise ValueError(f"bad -H entry {part!r}: slots must be >= 1")
+        out.append((host, n))
+    if not out:
+        raise ValueError(f"-H {spec!r} lists no hosts")
+    return out
+
+
+def _is_local_host(host: str) -> bool:
+    return host in ("localhost", "127.0.0.1", "::1", socket.gethostname())
+
+
+def env_whitelist(env: dict) -> dict:
+    """The subset of ``env`` forwarded across ssh (prefix whitelist)."""
+    return {k: v for k, v in env.items()
+            if k.startswith(_FORWARD_PREFIXES)}
+
+
+def ssh_command(host: str, cmd, env: dict, cwd: str,
+                pidfile: str = None) -> list:
+    """The ssh invocation for one remote rank: non-interactive, forwards
+    the env whitelist inline (sshd's AcceptEnv cannot be assumed), recreates
+    the working directory, and execs the user command.  ``pidfile`` records
+    the remote shell's pid (kept by ``exec``) so teardown can kill the real
+    remote process — killing the local ssh client alone would orphan it."""
+    pid = f"echo $$ > {shlex.quote(pidfile)}; " if pidfile else ""
+    inner = "{}cd {} && exec env {} {}".format(
+        pid,
+        shlex.quote(cwd),
+        " ".join(f"{k}={shlex.quote(v)}" for k, v in sorted(env.items())),
+        " ".join(shlex.quote(c) for c in cmd),
+    )
+    return ["ssh", "-o", "BatchMode=yes", "-o", "StrictHostKeyChecking=no",
+            host, inner]
+
+
+class _Rank:
+    """One spawned rank: the local Popen (the rank itself, or its ssh
+    client) plus what remote teardown needs."""
+
+    __slots__ = ("proc", "host", "pidfile")
+
+    def __init__(self, proc, host, pidfile=None):
+        self.proc = proc
+        self.host = host
+        self.pidfile = pidfile
+
+    @property
+    def remote(self):
+        return self.pidfile is not None
+
+
+def _spawn_rank(host: str, cmd, child_env: dict, tag: str, r: int) -> _Rank:
+    """Spawn one rank: fork locally, ssh for a remote host.  Each child is
+    its own process group so a launcher timeout can kill the whole tree."""
+    if _is_local_host(host):
+        proc = subprocess.Popen(cmd, env=child_env, start_new_session=True)
+        return _Rank(proc, host)
+    pidfile = f"/tmp/{tag}-r{r}.pid"
+    full = ssh_command(host, cmd, env_whitelist(child_env), os.getcwd(),
+                       pidfile=pidfile)
+    return _Rank(subprocess.Popen(full, start_new_session=True), host, pidfile)
+
+
+def _ssh_best_effort(host: str, script: str, timeout: float = 15.0):
+    """Run a teardown/cleanup snippet on a remote host; failures are
+    reported but never raised (the host may be unreachable already)."""
+    try:
+        subprocess.run(
+            ["ssh", "-o", "BatchMode=yes", "-o", "StrictHostKeyChecking=no",
+             "-o", "ConnectTimeout=5", host, script],
+            timeout=timeout, capture_output=True,
+        )
+    except Exception as e:  # noqa: BLE001
+        print(f"bftpu-run: remote cleanup on {host} failed: {e!r}",
+              file=sys.stderr)
+
+
+def _kill_local(ranks, sig=signal.SIGTERM):
+    for rk in ranks:
+        if rk.proc.poll() is None:
+            try:
+                os.killpg(rk.proc.pid, sig)
+            except (ProcessLookupError, PermissionError):
+                rk.proc.send_signal(sig)
+
+
+def _kill_remote(ranks, sig="TERM"):
+    """Kill the real remote processes via their pidfiles (once, on
+    teardown — the local ssh client's death does not reach them)."""
+    for rk in ranks:
+        if rk.remote and rk.proc.poll() is None:
+            pf = shlex.quote(rk.pidfile)
+            _ssh_best_effort(
+                rk.host,
+                f"test -f {pf} && kill -{sig} $(cat {pf}); rm -f {pf}",
+            )
+
+
+def _supervise(ranks, timeout: float) -> int:
+    """Poll ALL children until done: rank k can die while rank 0 blocks in
+    the distributed rendezvous waiting for it — an in-order wait would only
+    report the failure after jax's multi-minute init timeout.  On the first
+    nonzero exit (or on ``--timeout`` expiry) the rest are torn down,
+    including the REAL processes behind ssh clients."""
+    code = 0
+    deadline = time.monotonic() + timeout if timeout else None
+    live = list(ranks)
+
+    def teardown(sig=signal.SIGTERM):
+        _kill_remote(ranks)
+        _kill_local(ranks, sig)
+
+    try:
+        while live:
+            for rk in list(live):
+                rc = rk.proc.poll()
+                if rc is None:
+                    continue
+                live.remove(rk)
+                if rc != 0 and code == 0:
+                    code = rc
+                    teardown()
+            if live and deadline is not None and time.monotonic() > deadline:
+                print(f"bftpu-run: timeout after {timeout:g}s; killing "
+                      f"{len(live)} live rank(s)", file=sys.stderr)
+                teardown()
+                time.sleep(2.0)
+                _kill_local(ranks, signal.SIGKILL)
+                return 124
+            if live:
+                time.sleep(0.05)
+    except KeyboardInterrupt:
+        teardown(signal.SIGINT)
+        code = 130
+    return code
+
+
+def _pick_port() -> int:
+    """An ephemeral port for the rendezvous.  Bind-then-close is a TOCTOU
+    (another process may grab it before the children bind), and for a
+    REMOTE head host the probe says nothing at all — both launch paths
+    therefore retry once with a fresh port when every child dies
+    immediately (the observable signature of a rendezvous bind failure)."""
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _head_address(by_rank) -> str:
+    """The rendezvous host every rank can reach.  Loopback only works when
+    all ranks share this machine; a locally-spelled first host must be
+    replaced with this machine's externally reachable name when any rank
+    is remote."""
+    if all(_is_local_host(h) for h in by_rank):
+        return "127.0.0.1"
+    head = by_rank[0]
+    return socket.getfqdn() if _is_local_host(head) else head
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="bftpu-run",
@@ -64,12 +257,28 @@ def main(argv=None) -> int:
         help="total number of processes (multi-host; maps to JAX_NUM_PROCESSES)",
     )
     parser.add_argument(
+        "-H", "--hosts",
+        default=None,
+        metavar="HOST:SLOTS,...",
+        help="host list with slot counts (reference bfrun -H [U]): ranks "
+        "are spawned host-major, over ssh for remote hosts.  Works with "
+        "-np (counts must agree) and with --islands (sets the hostmap so "
+        "window traffic rides shm intra-host and TCP inter-host)",
+    )
+    parser.add_argument(
         "--coordinator",
         default=None,
         help="coordinator address host:port for multi-host rendezvous",
     )
     parser.add_argument(
         "--process-id", type=int, default=None, help="this process's index"
+    )
+    parser.add_argument(
+        "--timeout",
+        type=float,
+        default=0.0,
+        help="kill the whole launch after this many seconds (0 = no limit); "
+        "guards against a child hanging in the distributed rendezvous",
     )
     parser.add_argument(
         "--simulate",
@@ -102,14 +311,28 @@ def main(argv=None) -> int:
     cmd = args.command
     if cmd and cmd[0] == "--":
         cmd = cmd[1:]
+
+    hosts = parse_hosts(args.hosts) if args.hosts else None
+    if hosts is not None:
+        total = sum(s for _, s in hosts)
+        if args.islands:
+            if args.islands != total:
+                parser.error(f"--islands {args.islands} but -H lists {total} slots")
+        elif args.np is None:
+            args.np = total
+        elif args.np != total:
+            parser.error(f"-np {args.np} but -H lists {total} slots")
+
     env = build_env(args)
     if args.islands:
-        return _run_islands(cmd, env, args.islands, args.job)
+        return _run_islands(cmd, env, args.islands, args.job, hosts,
+                            args.timeout)
     if args.np is not None and args.np > 1 and args.process_id is None:
         # `-np N` with no explicit process id: WE are the process launcher
         # (the reference's `bfrun -np N` execs mpirun which forks the ranks
         # [U]; here each child is one jax.distributed process)
-        return _run_multiprocess(cmd, env, args.np, args.coordinator)
+        return _run_multiprocess(cmd, env, args.np, args.coordinator, hosts,
+                                 args.timeout)
     try:
         os.execvpe(cmd[0], cmd, env)
     except FileNotFoundError:
@@ -117,96 +340,111 @@ def main(argv=None) -> int:
         return 127
 
 
-def _run_multiprocess(cmd, env, nprocs: int, coordinator: str | None) -> int:
-    """Spawn ``nprocs`` local jax.distributed processes (single-host
-    multi-process: the CPU-mesh integration mode, and one-host-many-
-    processes TPU debugging).  Real multi-host runs invoke bftpu-run once
-    per host with an explicit ``--process-id`` instead."""
-    import socket
-    import subprocess
+def _rank_hosts(hosts, nprocs: int) -> list:
+    """Host of each rank, host-major (``-H a:2,b:2`` -> a,a,b,b)."""
+    if hosts is None:
+        return ["localhost"] * nprocs
+    out = []
+    for host, slots in hosts:
+        out.extend([host] * slots)
+    return out
 
-    if coordinator is None:
-        # pick a free port for the rendezvous on this host
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            coordinator = f"127.0.0.1:{s.getsockname()[1]}"
-    import time
 
-    procs = []
-    for r in range(nprocs):
-        child_env = dict(env)
-        child_env["JAX_COORDINATOR_ADDRESS"] = coordinator
-        child_env["JAX_NUM_PROCESSES"] = str(nprocs)
-        child_env["JAX_PROCESS_ID"] = str(r)
-        procs.append(subprocess.Popen(cmd, env=child_env))
-    code = 0
-    # poll ALL children: rank k can die while rank 0 blocks in the
-    # distributed rendezvous waiting for it — an in-order wait would only
-    # report the failure after jax's multi-minute init timeout
-    live = list(procs)
-    while live:
-        for p in list(live):
-            rc = p.poll()
-            if rc is None:
-                continue
-            live.remove(p)
-            if rc != 0 and code == 0:
-                code = rc
-                for q in procs:
-                    if q.poll() is None:
-                        q.terminate()
-        if live:
-            time.sleep(0.05)
+def _run_multiprocess(cmd, env, nprocs: int, coordinator, hosts,
+                      timeout: float) -> int:
+    """Spawn ``nprocs`` jax.distributed processes: locally (the CPU-mesh
+    integration mode) or across machines with ``-H`` (ssh for remote
+    hosts, the reference's mpirun shape [U])."""
+    by_rank = _rank_hosts(hosts, nprocs)
+    tag = f"bfrun-{os.getpid()}-{int(time.time())}"
+    code = 1
+    for attempt in (0, 1):
+        coord = coordinator
+        if coord is None:
+            coord = f"{_head_address(by_rank)}:{_pick_port()}"
+        t0 = time.monotonic()
+        ranks = []
+        for r in range(nprocs):
+            child_env = dict(env)
+            child_env["JAX_COORDINATOR_ADDRESS"] = coord
+            child_env["JAX_NUM_PROCESSES"] = str(nprocs)
+            child_env["JAX_PROCESS_ID"] = str(r)
+            ranks.append(_spawn_rank(by_rank[r], cmd, child_env, tag, r))
+        code = _supervise(ranks, timeout)
+        if (code not in (0, 124) and coordinator is None and attempt == 0
+                and time.monotonic() - t0 < 20.0):
+            # every child died almost immediately: the classic signature of
+            # a rendezvous bind failure (local _pick_port TOCTOU, or the
+            # probed port not being free on a remote head) — retry once
+            print("bftpu-run: launch failed fast; retrying with a fresh "
+                  "rendezvous port", file=sys.stderr)
+            continue
+        return code
     return code
 
 
-def _run_islands(cmd, env, nranks: int, job: str | None) -> int:
-    """Fork N child processes, one island each (the `mpirun -np N` shape of
-    the reference's launcher [U], minus ssh/NIC plumbing: islands on one
-    host talk through shared memory).  Returns the first nonzero child exit
-    code, and tears the others down on failure."""
-    import signal
-    import subprocess
+def _cleanup_island_segments(job: str, by_rank) -> None:
+    """Reclaim the job's shm segments on EVERY host: a later run reusing
+    the job name must never attach to stale mailboxes/barrier state.
+    Remote hosts get a best-effort ssh cleanup (same env whitelist, so
+    PYTHONPATH reaches the package)."""
+    from bluefog_tpu.native import shm_native
 
+    shm_native.unlink_all(job)
+    pypath = os.environ.get("PYTHONPATH", "")
+    snippet = (
+        "from bluefog_tpu.native import shm_native; "
+        f"shm_native.unlink_all({job!r})"
+    )
+    for host in sorted({h for h in by_rank if not _is_local_host(h)}):
+        _ssh_best_effort(
+            host,
+            "env PYTHONPATH={} {} -c {}".format(
+                shlex.quote(pypath), shlex.quote(sys.executable or "python3"),
+                shlex.quote(snippet),
+            ),
+        )
+
+
+def _run_islands(cmd, env, nranks: int, job, hosts, timeout: float) -> int:
+    """Fork N island processes (the `mpirun -np N` shape of the reference's
+    launcher [U]).  With ``-H``, ranks spawn on their hosts over ssh and
+    the hostmap/coordinator env is set so window traffic rides shared
+    memory intra-host and TCP inter-host (routed transport).  Returns the
+    first nonzero child exit code, tearing the others down on failure."""
     job = job or f"bfrun{os.getpid()}"
-    procs = []
-    for r in range(nranks):
-        child_env = dict(env)
-        child_env["BLUEFOG_ISLAND_RANK"] = str(r)
-        child_env["BLUEFOG_ISLAND_SIZE"] = str(nranks)
-        child_env["BLUEFOG_ISLAND_JOB"] = job
-        procs.append(subprocess.Popen(cmd, env=child_env))
-    code = 0
-    try:
-        # poll ALL children: a rank can fail while its siblings are blocked
-        # in the shm barrier, so waiting in rank order would hang forever
-        import time as _time
-
-        live = list(procs)
-        while live:
-            for p in list(live):
-                rc = p.poll()
-                if rc is None:
-                    continue
-                live.remove(p)
-                if rc != 0 and code == 0:
-                    code = rc
-                    for q in procs:
-                        if q.poll() is None:
-                            q.send_signal(signal.SIGTERM)
-            if live:
-                _time.sleep(0.05)
-    except KeyboardInterrupt:
-        for q in procs:
-            if q.poll() is None:
-                q.send_signal(signal.SIGINT)
-        code = 130
-    finally:
-        # reclaim the job's segments on EVERY path: a later run reusing the
-        # job name must never attach to stale mailboxes/barrier state
-        from bluefog_tpu.native import shm_native
-
-        shm_native.unlink_all(job)
+    by_rank = _rank_hosts(hosts, nranks)
+    multi_host = hosts is not None and len(set(by_rank)) > 1
+    tag = f"bfrun-{os.getpid()}-{int(time.time())}"
+    code = 1
+    for attempt in (0, 1):
+        coord = (f"{_head_address(by_rank)}:{_pick_port()}"
+                 if multi_host else None)
+        t0 = time.monotonic()
+        ranks = []
+        for r in range(nranks):
+            child_env = dict(env)
+            child_env["BLUEFOG_ISLAND_RANK"] = str(r)
+            child_env["BLUEFOG_ISLAND_SIZE"] = str(nranks)
+            child_env["BLUEFOG_ISLAND_JOB"] = job
+            if multi_host:
+                child_env["BLUEFOG_ISLAND_HOSTMAP"] = ",".join(by_rank)
+                child_env["BLUEFOG_ISLAND_COORD"] = coord
+                if not _is_local_host(by_rank[r]):
+                    child_env["BLUEFOG_ISLAND_HOST"] = by_rank[r]
+            ranks.append(_spawn_rank(by_rank[r], cmd, child_env, tag, r))
+        try:
+            code = _supervise(ranks, timeout)
+        finally:
+            _cleanup_island_segments(job, by_rank)
+        if (code not in (0, 124, 130) and multi_host and attempt == 0
+                and time.monotonic() - t0 < 20.0):
+            # same fast-failure signature as _run_multiprocess: the TCP
+            # rendezvous port may not have been free on the head host
+            print("bftpu-run: islands launch failed fast; retrying with a "
+                  "fresh rendezvous port", file=sys.stderr)
+            continue
+        return code
     return code
 
 
