@@ -68,6 +68,9 @@ def main():
                     help="override the preset's per-rank batch (A/B sweeps)")
     ap.add_argument("--blocks", type=int, default=0,
                     help="override the flash block_q=block_k size (A/B sweeps)")
+    ap.add_argument("--remat-policy", default=None,
+                    choices=[None, "dots", "dots_no_batch"],
+                    help="checkpoint policy under remat presets (A/B sweeps)")
     args = ap.parse_args()
     cfg = dict(PRESETS[args.preset])
     if args.batch:
@@ -82,6 +85,7 @@ def main():
         vocab_size=cfg["vocab"], hidden_size=cfg["hidden"],
         num_layers=cfg["layers"], num_heads=cfg["heads"], dff=cfg["dff"],
         remat=cfg.get("remat", False),
+        remat_policy=args.remat_policy,
         scan_layers=cfg.get("scan_layers", False),
         attention_fn=(
             # explicit pallas/xla is honored everywhere (interpret mode off

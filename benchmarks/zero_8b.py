@@ -1,0 +1,134 @@
+"""8B feasibility: lower the FSDP+gossip train step at TRUE 8B dims and
+print the per-chip memory table (round-3 verdict #8).
+
+Nothing is materialized — params come from ``jax.eval_shape`` and the step
+is AOT-``lower``-ed on ShapeDtypeStructs, so this runs on any host while
+validating that the full program (scan+remat Llama fwd/bwd, per-leaf
+reduce-scatter, sharded update, machine gossip) traces and lowers with the
+real shapes and shardings.  The arithmetic table is the memory proof; the
+small-scale execution proof is ``tests/test_zero.py`` + the driver's
+``dryrun_multichip`` ZeRO section.
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     python benchmarks/zero_8b.py
+"""
+
+import json
+import os
+import sys
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bluefog_tpu as bf
+from bluefog_tpu import topology_util
+from bluefog_tpu.core import basics
+from bluefog_tpu.core.basics import LOCAL_AXIS, MACHINES_AXIS
+from bluefog_tpu.models.transformer import LlamaLM
+from bluefog_tpu.parallel.zero import make_fsdp_gossip_train_step
+
+# Llama-8B-class config (Llama-2-7B shape + wider dff rounds it to ~8.0B)
+CFG = dict(vocab=32000, hidden=4096, layers=32, heads=32, dff=14336,
+           seq=2048, batch=1)
+
+
+def main():
+    machines_local = os.environ.get("ZERO8B_MESH", "2x4")
+    machines, local = (int(x) for x in machines_local.split("x"))
+    bf.init(local_size=local)
+    ctx = basics.context()
+    assert ctx.hier_mesh.devices.shape == (machines, local), (
+        ctx.hier_mesh.devices.shape)
+    bf.set_machine_topology(topology_util.ExponentialTwoGraph(machines))
+
+    lm = LlamaLM(
+        vocab_size=CFG["vocab"], hidden_size=CFG["hidden"],
+        num_layers=CFG["layers"], num_heads=CFG["heads"], dff=CFG["dff"],
+        remat=True, scan_layers=True,
+    )
+    B, T = CFG["batch"], CFG["seq"]
+    ids0 = jnp.ones((B, T), jnp.int32)
+    # shapes only — nothing materialized
+    var_shapes = jax.eval_shape(lm.init, jax.random.PRNGKey(0), ids0)
+    p_shapes = var_shapes["params"]
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(p_shapes))
+
+    def apply_fn(p, ids):
+        return lm.apply({"params": p}, ids)
+
+    def loss_fn(logits, labels):
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+        return -jnp.mean(
+            jnp.take_along_axis(logp, labels[:, 1:, None], axis=-1))
+
+    init_fn, step_fn, _ = make_fsdp_gossip_train_step(
+        apply_fn, loss_fn, ctx.hier_mesh, ctx.machine_plan,
+        learning_rate=3e-4, momentum=0.9,
+    )
+
+    # state ShapeDtypeStructs with the EXACT shardings init_fn would give
+    # (fsdp_state_struct shares init_fn's spec logic — no drift)
+    from bluefog_tpu.parallel.zero import fsdp_state_struct
+
+    master = jax.tree_util.tree_map(
+        lambda l: fsdp_state_struct(l, ctx.hier_mesh), p_shapes)
+    mu = jax.tree_util.tree_map(
+        lambda l: fsdp_state_struct(l, ctx.hier_mesh), p_shapes)
+    data_sh = NamedSharding(ctx.hier_mesh, P(MACHINES_AXIS, LOCAL_AXIS))
+    ids_s = jax.ShapeDtypeStruct((machines, local * B, T), jnp.int32,
+                                 sharding=data_sh)
+    lowered = step_fn.lower({"master": master, "mu": mu}, ids_s, ids_s)
+    hlo_bytes = len(lowered.as_text())
+
+    # --- the memory table (per chip, f32/bf16 bytes) ----------------------
+    # Per-leaf FSDP's transient ceiling is the LARGEST LEAF (bf16 gather +
+    # f32 grad before scatter).  Two leaf granularities:
+    #   - scan-stacked (what lowered above): the [32, 4096, 14336] FFN
+    #     stack is one leaf -> 11.3 GB transient, does NOT fit 16 GB.
+    #     XLA may slice the gather per scan iteration, but that is
+    #     scheduling-dependent and unproven at this scale;
+    #   - unrolled per-layer leaves: largest leaf 4096x14336 -> 0.35 GB
+    #     transient, fits comfortably.  8B therefore ships UNROLLED
+    #     under FSDP (the scan form exists for compile-service limits,
+    #     which pods without the tunnel do not share).
+    gb = 1e9
+
+    def table(local_, biggest_elems):
+        state_shard = 4 * n_params / local_ / gb
+        transient = (2 + 4) * biggest_elems / gb
+        acts = CFG["layers"] * B * T * CFG["hidden"] * 2 / gb
+        return {
+            "master_f32_shard": round(state_shard, 2),
+            "momentum_f32_shard": round(state_shard, 2),
+            "largest_leaf_transients": round(transient, 2),
+            "remat_boundaries": round(acts, 2),
+            "total_core": round(2 * state_shard + transient + acts, 2),
+        }
+
+    stacked_big = max(int(np.prod(l.shape))
+                      for l in jax.tree_util.tree_leaves(p_shapes))
+    unrolled_big = CFG["hidden"] * CFG["dff"]
+    print(json.dumps({
+        "metric": "8B FSDP+gossip feasibility (lower-only)",
+        "params_b": round(n_params / 1e9, 3),
+        "lowered_mesh": f"{machines}x{local}",
+        "lowered_stablehlo_bytes": hlo_bytes,
+        "per_chip_gb_scan_stacked_local8": table(8, stacked_big),
+        "per_chip_gb_unrolled_local8": table(8, unrolled_big),
+        "verdict": ("unrolled-leaf FSDP at local=8 fits a 16 GB v5e "
+                    "(~9 GB core + activations); scan-stacked leaves do "
+                    "not unless XLA slices the gather per layer"),
+    }))
+
+
+if __name__ == "__main__":
+    main()

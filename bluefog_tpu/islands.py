@@ -721,6 +721,15 @@ class DistributedWinPutOptimizer:
     per step instead of two per leaf).  ``num_steps_per_communication``
     mirrors the reference's local-SGD cadence knob.
 
+    ``overlap=True`` runs the host side of each gossip round (device→host
+    staging, deposits, combine) on a background thread while the caller
+    computes the next gradients; the combine is applied one step later
+    (AD-PSGD-style staleness — the reference's background-thread
+    semantics).  CONTRACT: the params returned by ``step`` are handed to
+    the background thread by reference, so the caller must NOT donate
+    them to a jitted function before the next ``step``/``finish`` call
+    (donation deletes the buffers under the in-flight staging copy).
+
     Usage (inside an island process)::
 
         opt = islands.DistributedWinPutOptimizer(optax.sgd(0.1))
@@ -729,15 +738,19 @@ class DistributedWinPutOptimizer:
     """
 
     def __init__(self, base_optimizer, window_prefix: str = "island_winput",
-                 num_steps_per_communication: int = 1):
+                 num_steps_per_communication: int = 1,
+                 overlap: bool = False):
         import optax  # local import: islands itself is numpy-only otherwise
 
         del optax
         self.base = base_optimizer
         self.prefix = window_prefix
         self.k = int(num_steps_per_communication)
+        self.overlap = bool(overlap)
         self._step_count = 0
         self._groups = None  # [(leaf_indices, shapes, sizes, np_dtype)]
+        self._executor = None  # 1-thread pool, created lazily (overlap mode)
+        self._pending = None   # Future[list of combined buffers per group]
 
     def _pack(self, flat, idxs, dtype):
         return np.concatenate(
@@ -783,16 +796,74 @@ class DistributedWinPutOptimizer:
                 flat[i] = jnp.asarray(arr, dtype=leaf.dtype)
             off += size
 
+    # -- overlap machinery (round-3 verdict #5 / SURVEY §3.3: the
+    # reference's background thread lands MPI_Put while the device keeps
+    # computing; here a 1-thread pool runs the whole host side of a gossip
+    # round — device→host staging, shm deposits, mailbox combine — while
+    # the caller's NEXT forward/backward executes on device) ------------
+
+    def _gossip_round(self, leaf_refs):
+        """The background half of one gossip round.  ``leaf_refs`` are the
+        (possibly still-computing) device arrays; ``np.asarray`` inside
+        ``_pack`` blocks until the device produces them — in THIS thread,
+        so the main thread has already returned and dispatched more work.
+        Returns the combined buffer per group."""
+        out = []
+        for g, (idxs, _, _, dt) in enumerate(self._groups):
+            name = f"{self.prefix}.{g}"
+            win_put(self._pack(leaf_refs, idxs, dt), name)
+            out.append(win_update(name))
+        return out
+
+    def _apply_pending(self, params):
+        """Wait for the in-flight gossip round (if any) and swap its
+        combined values into ``params`` — the one-step-stale combine of
+        AD-PSGD-style overlap."""
+        import jax
+
+        if self._pending is None:
+            return params
+        combineds = self._pending.result()
+        self._pending = None
+        flat, treedef = jax.tree_util.tree_flatten(params)
+        for g, (idxs, shapes, sizes, _) in enumerate(self._groups):
+            self._unpack_into(flat, combineds[g], idxs, shapes, sizes)
+        return jax.tree_util.tree_unflatten(treedef, flat)
+
+    def finish(self, params):
+        """Drain the overlap pipeline: apply any in-flight combine.  Call
+        after the training loop (before settle/evaluation/checkpoint)."""
+        return self._apply_pending(params)
+
     def step(self, params, grads, state):
         import jax
         import optax
 
+        if self.overlap:
+            # combine-then-adapt on the freshest gossip: the in-flight
+            # round deposited LAST step's params while the caller computed
+            # ``grads`` (at those same params) — apply it first so the
+            # local update lands on the combined point
+            params = self._apply_pending(params)
         updates, state = self.base.update(grads, state, params)
         params = optax.apply_updates(params, updates)
         self._step_count += 1
         if self._step_count % self.k != 0:
             return params, state
         flat, treedef = jax.tree_util.tree_flatten(params)
+        if self.overlap:
+            if self._executor is None:
+                import concurrent.futures
+
+                self._executor = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1,
+                    thread_name_prefix=f"{self.prefix}.gossip",
+                )
+            # hand the DEVICE refs to the background thread: it blocks on
+            # device completion there, then runs the shm round while the
+            # caller's next step computes
+            self._pending = self._executor.submit(self._gossip_round, flat)
+            return params, state
         for g, (idxs, shapes, sizes, dt) in enumerate(self._groups):
             name = f"{self.prefix}.{g}"
             win_put(self._pack(flat, idxs, dt), name)
@@ -807,6 +878,7 @@ class DistributedWinPutOptimizer:
         loop (all ranks, same ``rounds``); returns the combined params."""
         import jax
 
+        params = self._apply_pending(params)  # drain the overlap pipeline
         for _ in range(rounds):
             flat, treedef = jax.tree_util.tree_flatten(params)
             for g, (idxs, _, _, dt) in enumerate(self._groups):
@@ -820,7 +892,21 @@ class DistributedWinPutOptimizer:
         return params
 
     def free(self):
-        """Collective: release the optimizer's windows."""
+        """Collective: release the optimizer's windows (drains the overlap
+        thread first — a deposit must not race the teardown barrier)."""
+        if self._pending is not None:
+            try:
+                # drain only: the combine is discarded, and a failed round
+                # (e.g. a peer tore the window down) must not skip the
+                # collective win_free below — siblings would block forever
+                # in its barrier
+                self._pending.result()
+            except Exception:  # noqa: BLE001
+                pass
+            self._pending = None
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+            self._executor = None
         for g in range(len(self._groups or [])):
             win_free(f"{self.prefix}.{g}")
 

@@ -159,6 +159,25 @@ class _DecoderBlock(nn.Module):
         return x
 
 
+def _remat_block(policy_name):
+    """``nn.remat`` over the decoder block with a named checkpoint policy.
+
+    ``None``/"" = recompute everything (minimum memory, +~2N flops/token);
+    "dots" = ``jax.checkpoint_policies.checkpoint_dots`` (save matmul
+    outputs: recompute shrinks to elementwise/norm passes at the cost of
+    O(layers·B·T·dff) saved activations); "dots_no_batch" =
+    ``checkpoint_dots_with_no_batch_dims``, the PaLM-style middle ground.
+    """
+    if not policy_name:
+        return nn.remat(_DecoderBlock)
+    policies = {
+        "dots": jax.checkpoint_policies.checkpoint_dots,
+        "dots_no_batch":
+            jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    }
+    return nn.remat(_DecoderBlock, policy=policies[policy_name])
+
+
 class _ScannedDecoderBlock(nn.Module):
     """nn.scan body adapter: carry = activations, no per-step outputs."""
 
@@ -167,10 +186,12 @@ class _ScannedDecoderBlock(nn.Module):
     dtype: Any
     attention_fn: Optional[Callable] = None
     remat: bool = False
+    remat_policy: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, positions):
-        cls = nn.remat(_DecoderBlock) if self.remat else _DecoderBlock
+        cls = (_remat_block(self.remat_policy) if self.remat
+               else _DecoderBlock)
         x = cls(self.num_heads, self.dff, self.dtype, self.attention_fn)(
             x, positions
         )
@@ -193,6 +214,7 @@ class LlamaLM(nn.Module):
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[Callable] = None
     remat: bool = False  # rematerialize each block: activations O(layers·B·T·d) -> O(B·T·d)
+    remat_policy: Optional[str] = None  # see _remat_block: None|"dots"|"dots_no_batch"
     scan_layers: bool = False  # lax.scan over stacked layers: O(1)-size HLO
 
     @nn.compact
@@ -214,11 +236,12 @@ class LlamaLM(nn.Module):
             )
             x, _ = scan(
                 self.num_heads, self.dff, self.dtype, self.attention_fn,
-                self.remat,
+                self.remat, self.remat_policy,
             )(x, positions)
         else:
             # remat selection for the scan path lives in _ScannedDecoderBlock
-            block_cls = nn.remat(_DecoderBlock) if self.remat else _DecoderBlock
+            block_cls = (_remat_block(self.remat_policy) if self.remat
+                         else _DecoderBlock)
             for _ in range(self.num_layers):
                 x = block_cls(
                     self.num_heads, self.dff, self.dtype, self.attention_fn
