@@ -75,6 +75,12 @@ def main():
     cfg = dict(PRESETS[args.preset])
     if args.batch:
         cfg["batch"] = args.batch
+    if args.remat_policy and not cfg.get("remat"):
+        # LlamaLM only consults remat_policy under remat=True; silently
+        # attributing a number to a policy that never applied would
+        # poison the A/B sweep
+        ap.error(f"--remat-policy requires a remat preset "
+                 f"(preset {args.preset!r} has remat=False)")
 
     bf.init()
     n = bf.size()

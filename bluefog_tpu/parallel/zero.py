@@ -176,8 +176,18 @@ def make_zero_gossip_train_step(
 
     step_box = {}
 
+    def _layout():
+        if "l" not in layout_box:
+            raise RuntimeError(
+                "call init_fn(params) first: the packed layout "
+                "(shapes/offsets) comes from the params tree — when "
+                "restoring state from a checkpoint, still call init_fn "
+                "with a matching params tree to rebuild it"
+            )
+        return layout_box["l"]
+
     def step_fn(state, batch, labels):
-        layout = layout_box["l"]
+        layout = _layout()
         if "f" not in step_box:
             step_box["f"] = step_fn_factory(layout)
         master, mu, loss = step_box["f"](
@@ -186,7 +196,7 @@ def make_zero_gossip_train_step(
         return {"master": master, "mu": mu}, loss
 
     def params_of(state):
-        layout = layout_box["l"]
+        layout = _layout()
         grid = state["master"]
         vec = jnp.reshape(grid[0], (-1,))  # machine 0's replica
         return unpack_params(vec, layout, compute_dtype)

@@ -1,0 +1,180 @@
+"""Interactive multi-rank island sessions — the ``ibfrun`` twin for the
+TRUE multi-process runtime (round-3 verdict #9 / round-2 missing #3).
+
+The reference's ``ibfrun`` (``bluefog/run/interactive_run.py`` [U],
+SURVEY.md §2.2) keeps persistent MPI daemons alive so Jupyter cells can
+drive a live multi-rank job.  ``run/interactive.py`` covers the
+single-controller case (where the daemons dissolve); THIS module covers
+the islands case: N persistent OS processes, each owning its island
+runtime (windows, mailboxes, mutexes stay ALIVE between cells), driven
+from the notebook one task at a time.
+
+    from bluefog_tpu.run.interactive_islands import IslandSession
+
+    sess = IslandSession(4)                    # cell 1: spawn the workers
+    sess.run(lambda rank, size: islands_setup(...))
+    sess.run(step_fn, lr=0.1)                  # cell 2..n: live gossip
+    sess.shutdown()                            # last cell
+
+Functions are shipped with cloudpickle, so notebook-defined closures
+work.  Each ``run`` broadcasts one callable ``fn(rank, size, *args,
+**kwargs)`` to every worker and returns the per-rank results in rank
+order; exceptions on any rank are re-raised in the driving kernel with
+the worker traceback attached.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+from typing import Any, List, Optional
+
+__all__ = ["IslandSession"]
+
+_session_counter = itertools.count()
+
+
+def _worker_loop(rank: int, size: int, job: str, conn) -> None:
+    """One persistent island worker: init once, serve tasks until the
+    shutdown sentinel, then tear down collectively."""
+    import cloudpickle
+
+    from bluefog_tpu import islands
+
+    try:
+        islands.init(rank, size, job)
+        conn.send(("ready", rank))
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        conn.send(("error", f"{e}\n{traceback.format_exc()}"))
+        return
+    while True:
+        try:
+            msg = conn.recv()
+        except EOFError:
+            # driver died or the session was GC'd without shutdown():
+            # treat like the sentinel so teardown/unlink still runs
+            msg = None
+        if msg is None:  # shutdown sentinel
+            break
+        try:
+            fn, args, kwargs = cloudpickle.loads(msg)
+            out = fn(rank, size, *args, **kwargs)
+            conn.send(("ok", out))
+        except Exception as e:  # noqa: BLE001
+            import traceback
+
+            conn.send(("error", f"{e}\n{traceback.format_exc()}"))
+    try:
+        islands.barrier()
+        islands.shutdown(unlink=(rank == 0))
+    except Exception:  # noqa: BLE001 — peers may already be gone
+        pass
+    conn.send(("bye", rank))
+
+
+class IslandSession:
+    """N persistent island processes driven from this (notebook) process.
+
+    State persists across ``run`` calls: a window created in one cell is
+    live in the next — the property ``ibfrun`` exists for.
+    """
+
+    def __init__(self, nranks: int, job: Optional[str] = None,
+                 timeout: float = 300.0):
+        import multiprocessing as mp
+
+        self.nranks = nranks
+        self.timeout = timeout
+        self.job = job or (
+            f"ibf{os.getpid()}_{next(_session_counter)}"
+        )
+        ctx = mp.get_context("spawn")  # fresh interpreters (own JAX runtime)
+        self._conns = []
+        self._procs = []
+        for r in range(nranks):
+            parent, child = ctx.Pipe()
+            p = ctx.Process(
+                target=_worker_loop, args=(r, nranks, self.job, child),
+                daemon=True,
+            )
+            p.start()
+            self._conns.append(parent)
+            self._procs.append(p)
+        for r, conn in enumerate(self._conns):
+            self._expect(conn, r, ("ready",))
+        self._alive = True
+
+    def _expect(self, conn, rank, kinds):
+        if not conn.poll(self.timeout):
+            self.terminate()
+            raise TimeoutError(
+                f"island worker {rank} did not answer within "
+                f"{self.timeout:g}s"
+            )
+        kind, payload = conn.recv()
+        if kind == "error":
+            self.terminate()
+            raise RuntimeError(f"island worker {rank} failed:\n{payload}")
+        if kind not in kinds:
+            self.terminate()
+            raise RuntimeError(
+                f"island worker {rank}: unexpected reply {kind!r}")
+        return payload
+
+    def run(self, fn, *args, **kwargs) -> List[Any]:
+        """Run ``fn(rank, size, *args, **kwargs)`` on EVERY rank; returns
+        per-rank results in rank order.  Collective ops inside ``fn`` are
+        fine — all ranks execute the same cell."""
+        if not self._alive:
+            raise RuntimeError("session is shut down")
+        import cloudpickle
+
+        blob = cloudpickle.dumps((fn, args, kwargs))
+        for conn in self._conns:
+            conn.send(blob)
+        return [self._expect(conn, r, ("ok",))
+                for r, conn in enumerate(self._conns)]
+
+    def shutdown(self) -> None:
+        """Collective teardown: windows freed, segments unlinked."""
+        if not self._alive:
+            return
+        for conn in self._conns:
+            conn.send(None)
+        for r, conn in enumerate(self._conns):
+            self._expect(conn, r, ("bye",))
+        for p in self._procs:
+            p.join(self.timeout)
+        self._alive = False
+
+    def terminate(self) -> None:
+        """Hard kill (error paths); reclaims the job's shm segments.
+
+        Workers are joined (then killed) BEFORE the unlink: SIGTERM is
+        asynchronous, and a worker mid win_create could re-create a
+        segment after the unlink, leaking it (same ordering as
+        ``islands.spawn``)."""
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+        for p in self._procs:
+            p.join(10.0)
+            if p.is_alive():
+                p.kill()
+                p.join(10.0)
+        from bluefog_tpu.native import shm_native
+
+        shm_native.unlink_all(self.job)
+        self._alive = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if self._alive:
+            try:
+                self.shutdown()
+            except Exception:  # noqa: BLE001
+                self.terminate()
