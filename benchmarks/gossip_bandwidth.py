@@ -255,7 +255,15 @@ def _measure_spmd_inner(ctx, topo, n, label, mb, iters, warmup):
     plan = ctx.plan
 
     elems = max(int(mb * 1e6 / 4), 1)
-    x = jnp.ones((n, elems), jnp.float32)
+    # pre-place with the mesh sharding: an unplaced input pays a full
+    # payload reshard on EVERY call (measured ~8 ms/call on CPU), which
+    # would measure the resharder, not the wire
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from bluefog_tpu.core.basics import NODES_AXIS
+
+    x = jax.device_put(jnp.ones((n, elems), jnp.float32),
+                       NamedSharding(ctx.mesh, P(NODES_AXIS)))
     payload_bytes = elems * 4
     # one send per out-edge per exchange, summed over ranks
     edges = sum(len(cls.perm) for cls in plan.classes)

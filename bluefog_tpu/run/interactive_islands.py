@@ -37,11 +37,13 @@ _session_counter = itertools.count()
 def _worker_loop(rank: int, size: int, job: str, conn) -> None:
     """One persistent island worker: init once, serve tasks until the
     shutdown sentinel, then tear down collectively."""
-    import cloudpickle
-
-    from bluefog_tpu import islands
-
     try:
+        # inside the try: a missing cloudpickle must surface as an
+        # ('error', ...) reply, not a silent driver-side timeout
+        import cloudpickle
+
+        from bluefog_tpu import islands
+
         islands.init(rank, size, job)
         conn.send(("ready", rank))
     except Exception as e:  # noqa: BLE001
@@ -123,6 +125,53 @@ class IslandSession:
                 f"island worker {rank}: unexpected reply {kind!r}")
         return payload
 
+    def _collect(self, kinds) -> List[Any]:
+        """One reply per rank, polled ACROSS ranks: a failure on any rank
+        surfaces immediately with its real traceback, even while other
+        ranks block in a collective waiting for the failed one."""
+        import time as _time
+
+        results: dict = {}
+        deadline = _time.monotonic() + self.timeout
+        while len(results) < self.nranks:
+            progressed = False
+            for r, conn in enumerate(self._conns):
+                if r in results or not conn.poll(0.02):
+                    continue
+                progressed = True
+                kind, payload = conn.recv()
+                if kind == "error":
+                    self.terminate()
+                    raise RuntimeError(
+                        f"island worker {r} failed:\n{payload}")
+                if kind not in kinds:
+                    self.terminate()
+                    raise RuntimeError(
+                        f"island worker {r}: unexpected reply {kind!r}")
+                results[r] = payload
+            if not progressed and _time.monotonic() > deadline:
+                missing = sorted(set(range(self.nranks)) - set(results))
+                self.terminate()
+                raise TimeoutError(
+                    f"island worker(s) {missing} did not answer within "
+                    f"{self.timeout:g}s"
+                )
+        return [results[r] for r in range(self.nranks)]
+
+    def _send_all(self, payloads) -> None:
+        """Broadcast with dead-worker detection: a broken pipe (worker
+        OOM-killed/segfaulted between cells) tears the session down
+        instead of leaving it half-alive with segments unreclaimed."""
+        try:
+            for conn, blob in zip(self._conns, payloads):
+                conn.send(blob)
+        except (BrokenPipeError, OSError) as e:
+            self.terminate()
+            raise RuntimeError(
+                "an island worker died between cells (broken pipe); "
+                "session terminated and segments reclaimed"
+            ) from e
+
     def run(self, fn, *args, **kwargs) -> List[Any]:
         """Run ``fn(rank, size, *args, **kwargs)`` on EVERY rank; returns
         per-rank results in rank order.  Collective ops inside ``fn`` are
@@ -132,19 +181,15 @@ class IslandSession:
         import cloudpickle
 
         blob = cloudpickle.dumps((fn, args, kwargs))
-        for conn in self._conns:
-            conn.send(blob)
-        return [self._expect(conn, r, ("ok",))
-                for r, conn in enumerate(self._conns)]
+        self._send_all([blob] * self.nranks)
+        return self._collect(("ok",))
 
     def shutdown(self) -> None:
         """Collective teardown: windows freed, segments unlinked."""
         if not self._alive:
             return
-        for conn in self._conns:
-            conn.send(None)
-        for r, conn in enumerate(self._conns):
-            self._expect(conn, r, ("bye",))
+        self._send_all([None] * self.nranks)
+        self._collect(("bye",))
         for p in self._procs:
             p.join(self.timeout)
         self._alive = False

@@ -42,7 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from bluefog_tpu.common.logging_util import logger
 from bluefog_tpu.core import basics
@@ -85,22 +85,28 @@ class _Window:
         self.shape = tensor.shape  # rank-major [size, ...]
         self.dtype = tensor.dtype
         maxd = max(plan.max_in_degree, 1)
-        self.self_tensor = jnp.asarray(tensor)
+        # Place every buffer with the mesh's rank-major sharding UP FRONT:
+        # the exchange jits return mesh-sharded outputs, so an unplaced
+        # initial buffer would change the call signature after the first
+        # exchange (one wasted recompile) and pay a full reshard on entry.
+        shard = NamedSharding(ctx.mesh, P(NODES_AXIS))
+        self.self_tensor = jax.device_put(jnp.asarray(tensor), shard)
         init = jnp.zeros((ctx.size, maxd) + tensor.shape[1:], dtype=tensor.dtype)
         if not zero_init:
             # Reference initializes each neighbor buffer with the local
             # tensor value so a pre-put win_update is a no-op average.
             init = init + jnp.expand_dims(jnp.asarray(tensor), 1)
-        self.mail = init
-        self.versions = jnp.zeros((ctx.size, maxd), dtype=jnp.int32)
+        self.mail = jax.device_put(init, shard)
+        self.versions = jax.device_put(
+            jnp.zeros((ctx.size, maxd), dtype=jnp.int32), shard)
         # push-sum associated scalars (mailbox follows the tensor-mailbox
         # init convention: zero_init -> empty, else neighbor's initial p=1)
-        self.p_self = jnp.ones((ctx.size,), dtype=jnp.float32)
-        self.p_mail = (
+        self.p_self = jax.device_put(
+            jnp.ones((ctx.size,), dtype=jnp.float32), shard)
+        self.p_mail = jax.device_put(
             jnp.zeros((ctx.size, maxd), dtype=jnp.float32)
             if zero_init
-            else jnp.ones((ctx.size, maxd), dtype=jnp.float32)
-        )
+            else jnp.ones((ctx.size, maxd), dtype=jnp.float32), shard)
         # device-resident host constants for the default-weights fused path
         self.default_consts = None
 

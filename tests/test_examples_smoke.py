@@ -72,3 +72,19 @@ def test_mnist_native_loader_pipeline():
     accs = [float(m) for m in re.findall(r"test acc \(rank0\) (\d+\.\d+)", proc.stdout)]
     assert len(accs) == 2, proc.stdout
     assert accs[-1] > 0.7, proc.stdout  # the synthetic task learns fast
+
+
+def test_zero_gossip_example():
+    """ZeRO-1 + gossip demo: sharded state, decreasing loss, 2x4 mesh."""
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        PYTHONPATH=REPO,
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples/jax_zero_gossip.py")],
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "zero gossip demo OK" in proc.stdout, proc.stdout

@@ -79,3 +79,26 @@ def test_island_session_error_propagates():
         sess.run(boom)
     # errors terminate the session and reclaim segments
     assert not sess._alive
+
+
+def test_island_session_one_rank_fails_while_other_blocks():
+    """rank 1 raises before the barrier rank 0 is waiting in: the real
+    traceback must surface promptly (cross-rank polling), not a timeout."""
+    import time
+
+    import pytest
+
+    def cell(rank, size):
+        from bluefog_tpu import islands
+
+        if rank == 1:
+            raise ValueError("rank1 exploded")
+        islands.barrier()  # waits for rank 1, which never arrives
+
+    sess = IslandSession(2, timeout=600.0)
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="rank1 exploded"):
+        sess.run(cell)
+    # surfaced by polling, far sooner than the 600 s timeout
+    assert time.monotonic() - t0 < 120.0
+    assert not sess._alive
