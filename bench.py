@@ -153,12 +153,12 @@ def main():
     )
     ar_times = [time_steps(
         step_ar, params, batch_stats, os_ar, batch, labels, warmup, iters)]
-    # UNCONDITIONAL interleaved min-of-3 per phase (round-2 verdict #3:
+    # UNCONDITIONAL interleaved min-of-4 per phase (round-2 verdict #3:
     # budget-gating let machine-noise drift move the headline ±10%).
     # Compiles are cached, so each extra pass is seconds; taking mins
     # cancels drift, and the recorded spread says how trustworthy the
     # round-over-round delta is.
-    for _ in range(2):
+    for _ in range(3):
         dec_times.append(time_steps(
             step_dec, params, batch_stats, os_dec, batch, labels, 1, iters))
         ar_times.append(time_steps(

@@ -87,6 +87,56 @@ def unpack_params(vec, layout: _Layout, dtype):
     return jax.tree_util.tree_unflatten(layout.treedef, leaves)
 
 
+def _make_update_rule(optimizer: str, lr: float, momentum: float,
+                      weight_decay: float):
+    """Elementwise update rule on a flat f32 shard (identical math to the
+    tree form — the partition is invisible to elementwise optimizers).
+
+    Returns ``(init, update)``: ``init(zeros_f32, zeros_i32)`` builds the
+    state tuple from the two zero-factories; ``update(g, state, w) ->
+    (delta, state)``.  "sgdm": state (mu,), ``momentum`` is the momentum
+    coefficient, ``weight_decay`` is L2 folded into the gradient.
+    "adamw": state (mu, nu, count); ``momentum`` maps to b1 and
+    ``weight_decay`` is DECOUPLED (applied to w, not g) per Loshchilov &
+    Hutter — with weight_decay=0 this is exactly ``optax.adam``.
+    """
+    wd = float(weight_decay)
+    if optimizer == "sgdm":
+        mom = float(momentum)
+
+        def init(zeros_f32, zeros_i32):
+            del zeros_i32
+            return (zeros_f32(),)
+
+        def update(g, state, w):
+            (mu,) = state
+            if wd:
+                g = g + wd * w
+            mu = mom * mu + g
+            return -lr * mu, (mu,)
+
+        return init, update
+    if optimizer == "adamw":
+        b1, b2, eps = float(momentum), 0.999, 1e-8
+
+        def init(zeros_f32, zeros_i32):
+            return (zeros_f32(), zeros_f32(), zeros_i32())
+
+        def update(g, state, w):
+            mu, nu, count = state
+            count = count + 1
+            mu = b1 * mu + (1 - b1) * g
+            nu = b2 * nu + (1 - b2) * g * g
+            c = count.astype(jnp.float32)
+            mu_hat = mu / (1 - b1 ** c)
+            nu_hat = nu / (1 - b2 ** c)
+            delta = -lr * (mu_hat / (jnp.sqrt(nu_hat) + eps) + wd * w)
+            return delta, (mu, nu, count)
+
+        return init, update
+    raise ValueError(f"optimizer must be 'sgdm' or 'adamw', got {optimizer!r}")
+
+
 def make_zero_gossip_train_step(
     apply_fn: Callable,
     loss_fn: Callable,
@@ -95,13 +145,17 @@ def make_zero_gossip_train_step(
     *,
     learning_rate: float = 1e-3,
     momentum: float = 0.9,
+    optimizer: str = "sgdm",
+    weight_decay: float = 0.0,
     compute_dtype=jnp.bfloat16,
 ):
     """Build ``(init_fn, step_fn, params_of)`` for ZeRO-1 + gossip training.
 
-    ``init_fn(params)`` -> state with master/momentum as
-    ``[machines, local, padded/local]`` f32 arrays sharded over BOTH mesh
-    axes (each chip stores exactly its shard).
+    ``init_fn(params)`` -> state with the f32 master and every optimizer
+    slot (``optimizer="sgdm"``: momentum; ``"adamw"``: mu/nu/count) as
+    ``[machines, local, padded/local]`` arrays sharded over BOTH mesh
+    axes (each chip stores exactly its shard — the ZeRO-1 partition
+    covers Adam's second moment too, the case the 8B table needs).
 
     ``step_fn(state, batch, labels) -> (state, mean_loss)`` — batch/labels
     lead with ``[machines, local, ...]``.
@@ -110,7 +164,9 @@ def make_zero_gossip_train_step(
     0's replica) for eval/checkpoint.
     """
     machines, local = hier_mesh.devices.shape
-    lr, mom = float(learning_rate), float(momentum)
+    lr = float(learning_rate)
+    opt_init, opt_update = _make_update_rule(
+        optimizer, lr, momentum, weight_decay)
     layout_box = {}
 
     def _layout_for(params):
@@ -130,11 +186,17 @@ def make_zero_gossip_train_step(
         )
         sharding = NamedSharding(hier_mesh, P(MACHINES_AXIS, LOCAL_AXIS))
         master = jax.device_put(grid, sharding)
-        mu = jax.device_put(jnp.zeros_like(grid), sharding)
-        return {"master": master, "mu": mu}
+        opt = opt_init(
+            lambda: jax.device_put(jnp.zeros_like(grid), sharding),
+            # per-replica step counter as [machines, local, 1] int32 so
+            # every state leaf shares the (machines, local) spec
+            lambda: jax.device_put(
+                jnp.zeros((machines, local, 1), jnp.int32), sharding),
+        )
+        return {"master": master, "opt": opt}
 
-    def _step(master, mu, batch, labels, layout):
-        # shard_map body: master/mu are [1, 1, shard_len]
+    def _step(master, opt, batch, labels, layout):
+        # shard_map body: master [1, 1, shard_len], opt leaves [1, 1, *]
         shard = master[0, 0]
         full = lax.all_gather(shard, LOCAL_AXIS, tiled=True)  # [padded] f32
         params = unpack_params(full, layout, compute_dtype)
@@ -149,8 +211,9 @@ def make_zero_gossip_train_step(
         g_shard = lax.psum_scatter(
             g, LOCAL_AXIS, scatter_dimension=0, tiled=True
         ) / local
-        mu_new = mom * mu[0, 0] + g_shard
-        shard = shard - lr * mu_new
+        delta, opt_new = opt_update(
+            g_shard, tuple(o[0, 0] for o in opt), shard)
+        shard = shard + delta
         # decentralized averaging across machines, PER SHARD: shard i of
         # machine m mixes with shard i of its machine-topology neighbors
         if machine_plan is not None and machines > 1:
@@ -158,7 +221,8 @@ def make_zero_gossip_train_step(
                 shard, machine_plan, MACHINES_AXIS
             )
         loss = lax.pmean(lax.pmean(loss, LOCAL_AXIS), MACHINES_AXIS)
-        return shard[None, None], mu_new[None, None], loss
+        return (shard[None, None],
+                tuple(o[None, None] for o in opt_new), loss)
 
     def step_fn_factory(layout):
         body = functools.partial(_step, layout=layout)
@@ -190,10 +254,10 @@ def make_zero_gossip_train_step(
         layout = _layout()
         if "f" not in step_box:
             step_box["f"] = step_fn_factory(layout)
-        master, mu, loss = step_box["f"](
-            state["master"], state["mu"], batch, labels
+        master, opt, loss = step_box["f"](
+            state["master"], state["opt"], batch, labels
         )
-        return {"master": master, "mu": mu}, loss
+        return {"master": master, "opt": opt}, loss
 
     def params_of(state):
         layout = _layout()
@@ -252,6 +316,8 @@ def make_fsdp_gossip_train_step(
     *,
     learning_rate: float = 1e-3,
     momentum: float = 0.9,
+    optimizer: str = "sgdm",
+    weight_decay: float = 0.0,
     compute_dtype=jnp.bfloat16,
 ):
     """FSDP-style ZeRO + gossip: per-LEAF sharding under GSPMD.
@@ -274,7 +340,9 @@ def make_fsdp_gossip_train_step(
     ``batch``/``labels``: ``[machines, per_machine_batch, ...]``.
     """
     machines, local = hier_mesh.devices.shape
-    lr, mom = float(learning_rate), float(momentum)
+    lr = float(learning_rate)
+    opt_init, opt_update = _make_update_rule(
+        optimizer, lr, momentum, weight_decay)
     W = None
     if machine_plan is not None and machines > 1:
         W = jnp.asarray(machine_plan.mixing_matrix(), jnp.float32)
@@ -289,8 +357,17 @@ def make_fsdp_gossip_train_step(
             return jax.device_put(stacked, _sharding(leaf.shape))
 
         master = jax.tree_util.tree_map(place, params)
-        mu = jax.tree_util.tree_map(jnp.zeros_like, master)
-        return {"master": master, "mu": mu}
+        opt = opt_init(
+            lambda: jax.tree_util.tree_map(jnp.zeros_like, master),
+            # per-replica, per-leaf step counter: [machines, 1, ...]
+            # int32, broadcastable against its leaf
+            lambda: jax.tree_util.tree_map(
+                lambda a: jax.device_put(
+                    jnp.zeros((machines,) + (1,) * (a.ndim - 1), jnp.int32),
+                    NamedSharding(hier_mesh, P(MACHINES_AXIS))),
+                master),
+        )
+        return {"master": master, "opt": opt}
 
     data_sharding_box = {}
 
@@ -313,7 +390,7 @@ def make_fsdp_gossip_train_step(
         data_spec = NamedSharding(hier_mesh, P(MACHINES_AXIS, LOCAL_AXIS))
 
         def step(state, batch, labels):
-            master, mu = state["master"], state["mu"]
+            master, opt = state["master"], state["opt"]
 
             def total_loss(master):
                 p = jax.tree_util.tree_map(
@@ -332,17 +409,28 @@ def make_fsdp_gossip_train_step(
             grads = jax.tree_util.tree_map(
                 lambda g, m: lax.with_sharding_constraint(
                     g, _sharding(m.shape[1:])), grads, master)
-            mu = jax.tree_util.tree_map(
-                lambda m_, g: mom * m_ + g, mu, grads)
-            master = jax.tree_util.tree_map(
-                lambda w, m_: w - lr * m_, master, mu)
+            # the elementwise update rule, leaf by leaf (state slots are
+            # trees shaped like master; the count slot broadcasts)
+            m_leaves, tdef = jax.tree_util.tree_flatten(master)
+            g_leaves = jax.tree_util.tree_leaves(grads)
+            o_leaves = [jax.tree_util.tree_leaves(o) for o in opt]
+            new_m, new_o = [], [[] for _ in opt]
+            for i, (w, g) in enumerate(zip(m_leaves, g_leaves)):
+                delta, o_new = opt_update(
+                    g, tuple(ol[i] for ol in o_leaves), w)
+                new_m.append(w + delta)
+                for slot, val in zip(new_o, o_new):
+                    slot.append(val)
+            master = jax.tree_util.tree_unflatten(tdef, new_m)
+            opt = tuple(jax.tree_util.tree_unflatten(tdef, slot)
+                        for slot in new_o)
             if W is not None:
                 master = jax.tree_util.tree_map(
                     lambda a: lax.with_sharding_constraint(
                         jnp.einsum("ms,s...->m...", W, a),
                         _sharding(a.shape[1:])),
                     master)
-            return {"master": master, "mu": mu}, jnp.mean(losses)
+            return {"master": master, "opt": opt}, jnp.mean(losses)
 
         return jax.jit(
             step,

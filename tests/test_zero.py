@@ -204,3 +204,103 @@ def test_fsdp_state_is_sharded(devices):
     # w1 [machines, 8, 12]: dim 12 shards over LOCAL=4 -> per-device (1, 8, 3)
     for s in state["master"]["w1"].addressable_shards:
         assert s.data.shape == (1, 8, 3), s.data.shape
+
+
+def _reference_step_adam(apply_fn, loss_fn, w_per_machine, opt_states,
+                         batch, labels, W, opts):
+    """Replica-per-machine ground truth with optax.adam (== the 'adamw'
+    rule with wd=0: bias-corrected moments, eps outside the sqrt)."""
+    new_w, new_s = [], []
+    for m in range(MACHINES):
+        def loss_all(p):
+            losses = [loss_fn(apply_fn(p, batch[m][l]), labels[m][l])
+                      for l in range(LOCAL)]
+            return sum(losses) / LOCAL
+
+        g = jax.grad(loss_all)(w_per_machine[m])
+        upd, s = opts[m].update(g, opt_states[m], w_per_machine[m])
+        import optax
+
+        new_w.append(optax.apply_updates(w_per_machine[m], upd))
+        new_s.append(s)
+    mixed = [jax.tree_util.tree_map(
+        lambda *ws: sum(W[m, s_] * ws[s_] for s_ in range(MACHINES)), *new_w)
+        for m in range(MACHINES)]
+    return mixed, new_s
+
+
+@pytest.mark.parametrize("variant", ["packed", "fsdp"])
+def test_zero_adamw_matches_optax_adam(devices, variant):
+    import optax
+
+    from bluefog_tpu.parallel.zero import (
+        make_fsdp_gossip_train_step,
+        make_zero_gossip_train_step,
+    )
+
+    ctx = _setup()
+    apply_fn, loss_fn, params = _model()
+    make = (make_zero_gossip_train_step if variant == "packed"
+            else make_fsdp_gossip_train_step)
+    init_fn, step_fn, params_of = make(
+        apply_fn, loss_fn, ctx.hier_mesh, ctx.machine_plan,
+        learning_rate=LR, optimizer="adamw", compute_dtype=jnp.float32,
+    )
+    state = init_fn(params)
+    rng = np.random.default_rng(3)
+    W = tu.GetWeightMatrix(tu.RingGraph(MACHINES))
+
+    opts = [optax.adam(LR) for _ in range(MACHINES)]
+    ref_w = [params for _ in range(MACHINES)]
+    ref_s = [opts[m].init(params) for m in range(MACHINES)]
+    for _ in range(4):
+        batch, labels = _data(rng)
+        if variant == "packed":
+            state, loss = step_fn(state, batch, labels)
+        else:
+            state, loss = step_fn(
+                state, batch.reshape(MACHINES, LOCAL * 4, 6),
+                labels.reshape(MACHINES, LOCAL * 4, 3))
+        assert np.isfinite(float(loss))
+        ref_w, ref_s = _reference_step_adam(
+            apply_fn, loss_fn, ref_w, ref_s, batch, labels, W, opts)
+
+    got = params_of(state)
+    for k in ("w1", "w2"):
+        np.testing.assert_allclose(
+            np.asarray(got[k], dtype=np.float32),
+            np.asarray(ref_w[0][k], dtype=np.float32),
+            rtol=3e-5, atol=3e-5,
+        )
+
+
+def test_zero_adamw_weight_decay_matches_optax_adamw(devices):
+    """weight_decay must be DECOUPLED (AdamW, not L2-in-grad): exact
+    match vs optax.adamw at wd=0.01."""
+    import optax
+
+    ctx = _setup()
+    apply_fn, loss_fn, params = _model()
+    init_fn, step_fn, params_of = make_zero_gossip_train_step(
+        apply_fn, loss_fn, ctx.hier_mesh, ctx.machine_plan,
+        learning_rate=LR, optimizer="adamw", weight_decay=0.01,
+        compute_dtype=jnp.float32,
+    )
+    state = init_fn(params)
+    rng = np.random.default_rng(5)
+    W = tu.GetWeightMatrix(tu.RingGraph(MACHINES))
+    opts = [optax.adamw(LR, weight_decay=0.01) for _ in range(MACHINES)]
+    ref_w = [params for _ in range(MACHINES)]
+    ref_s = [opts[m].init(params) for m in range(MACHINES)]
+    for _ in range(3):
+        batch, labels = _data(rng)
+        state, _ = step_fn(state, batch, labels)
+        ref_w, ref_s = _reference_step_adam(
+            apply_fn, loss_fn, ref_w, ref_s, batch, labels, W, opts)
+    got = params_of(state)
+    for k in ("w1", "w2"):
+        np.testing.assert_allclose(
+            np.asarray(got[k], dtype=np.float32),
+            np.asarray(ref_w[0][k], dtype=np.float32),
+            rtol=3e-5, atol=3e-5,
+        )
