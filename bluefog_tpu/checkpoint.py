@@ -26,7 +26,8 @@ import numpy as np
 
 from bluefog_tpu.core import basics
 
-__all__ = ["save", "restore", "save_consensus", "restore_broadcast"]
+__all__ = ["save", "restore", "restore_like", "save_consensus",
+           "restore_broadcast"]
 
 
 def _ckptr():
@@ -52,6 +53,35 @@ def save(path: str, tree: Any, *, mode: str = "all") -> None:
 def restore(path: str) -> Any:
     """Load a pytree saved by :func:`save` (mode='all' layout)."""
     return _ckptr().restore(os.path.abspath(path))
+
+
+def restore_like(path: str, like: Any) -> Any:
+    """Restore a pytree and re-place every leaf with the sharding (and
+    dtype) of the matching leaf in ``like`` — the exact-resume path for
+    SHARDED training state (e.g. ``parallel.zero`` master/opt grids,
+    where each chip must get back exactly its shard, not a replica)."""
+    # restore INTO the template's structure (orbax item=): leaf pairing
+    # is structural, not positional — a bare restore returns string-keyed
+    # dicts for tuple nodes, whose lexicographic flatten order permutes
+    # same-shaped leaves once a node has 10+ children
+    skeleton = jax.tree_util.tree_map(lambda _: 0, like)
+    restored = _ckptr().restore(os.path.abspath(path), item=skeleton)
+    r_leaves = jax.tree_util.tree_leaves(restored)
+    l_leaves, treedef = jax.tree_util.tree_flatten(like)
+    if len(r_leaves) != len(l_leaves):
+        raise ValueError(
+            f"checkpoint has {len(r_leaves)} leaves, template has "
+            f"{len(l_leaves)}"
+        )
+    out = []
+    for r, l in zip(r_leaves, l_leaves):
+        # cast on HOST: committing the full leaf to one device first
+        # would OOM at exactly the sharded-8B scale this API serves
+        arr = np.asarray(r, dtype=getattr(l, "dtype", None))
+        sh = getattr(l, "sharding", None)
+        out.append(jax.device_put(arr, sh) if sh is not None
+                   else jnp.asarray(arr))
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def save_consensus(path: str, tree: Any) -> None:

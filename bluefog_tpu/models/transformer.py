@@ -135,17 +135,32 @@ class _DecoderBlock(nn.Module):
     dff: int
     dtype: Any
     attention_fn: Optional[Callable] = None  # (q, k, v) -> out, e.g. ring attn
+    num_kv_heads: Optional[int] = None  # grouped-query attention (GQA)
 
     @nn.compact
     def __call__(self, x, positions):
         d = x.shape[-1]
         hd = d // self.num_heads
+        kvh = self.num_kv_heads or self.num_heads
+        if self.num_heads % kvh:
+            raise ValueError(
+                f"num_heads {self.num_heads} not divisible by "
+                f"num_kv_heads {kvh}")
         h = RMSNorm(dtype=self.dtype)(x)
         q = nn.DenseGeneral((self.num_heads, hd), use_bias=False, dtype=self.dtype)(h)
-        k = nn.DenseGeneral((self.num_heads, hd), use_bias=False, dtype=self.dtype)(h)
-        v = nn.DenseGeneral((self.num_heads, hd), use_bias=False, dtype=self.dtype)(h)
+        # GQA (Ainslie et al. 2023; Llama-3's 8-kv-head layout): k/v
+        # project to kvh heads (the parameter/KV-cache saving), then
+        # repeat up to num_heads for the attention math — correct for
+        # every attention_fn (flash/ring/dense) at the cost of not
+        # exploiting the smaller kv in the kernel's memory traffic
+        k = nn.DenseGeneral((kvh, hd), use_bias=False, dtype=self.dtype)(h)
+        v = nn.DenseGeneral((kvh, hd), use_bias=False, dtype=self.dtype)(h)
         q = _rotary(q, positions)
         k = _rotary(k, positions)
+        if kvh != self.num_heads:
+            rep = self.num_heads // kvh
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         if self.attention_fn is not None:
             att = self.attention_fn(q, k, v)
         else:
@@ -187,14 +202,14 @@ class _ScannedDecoderBlock(nn.Module):
     attention_fn: Optional[Callable] = None
     remat: bool = False
     remat_policy: Optional[str] = None
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, positions):
         cls = (_remat_block(self.remat_policy) if self.remat
                else _DecoderBlock)
-        x = cls(self.num_heads, self.dff, self.dtype, self.attention_fn)(
-            x, positions
-        )
+        x = cls(self.num_heads, self.dff, self.dtype, self.attention_fn,
+                self.num_kv_heads)(x, positions)
         return x, None
 
 
@@ -216,6 +231,7 @@ class LlamaLM(nn.Module):
     remat: bool = False  # rematerialize each block: activations O(layers·B·T·d) -> O(B·T·d)
     remat_policy: Optional[str] = None  # see _remat_block: None|"dots"|"dots_no_batch"
     scan_layers: bool = False  # lax.scan over stacked layers: O(1)-size HLO
+    num_kv_heads: Optional[int] = None  # GQA: kv heads < query heads
 
     @nn.compact
     def __call__(self, input_ids, positions=None):
@@ -236,7 +252,7 @@ class LlamaLM(nn.Module):
             )
             x, _ = scan(
                 self.num_heads, self.dff, self.dtype, self.attention_fn,
-                self.remat, self.remat_policy,
+                self.remat, self.remat_policy, self.num_kv_heads,
             )(x, positions)
         else:
             # remat selection for the scan path lives in _ScannedDecoderBlock
@@ -244,7 +260,8 @@ class LlamaLM(nn.Module):
                          else _DecoderBlock)
             for _ in range(self.num_layers):
                 x = block_cls(
-                    self.num_heads, self.dff, self.dtype, self.attention_fn
+                    self.num_heads, self.dff, self.dtype, self.attention_fn,
+                    self.num_kv_heads,
                 )(x, positions)
         x = RMSNorm(dtype=jnp.float32)(x)
         return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32)(x)
