@@ -71,6 +71,9 @@ def main():
     ap.add_argument("--remat-policy", default=None,
                     choices=[None, "dots", "dots_no_batch"],
                     help="checkpoint policy under remat presets (A/B sweeps)")
+    ap.add_argument("--kv-heads", type=int, default=0,
+                    help="grouped-query attention: kv head count "
+                    "(0 = MHA; must divide the preset's heads)")
     args = ap.parse_args()
     cfg = dict(PRESETS[args.preset])
     if args.batch:
@@ -92,6 +95,7 @@ def main():
         num_layers=cfg["layers"], num_heads=cfg["heads"], dff=cfg["dff"],
         remat=cfg.get("remat", False),
         remat_policy=args.remat_policy,
+        num_kv_heads=args.kv_heads or None,
         scan_layers=cfg.get("scan_layers", False),
         attention_fn=(
             # explicit pallas/xla is honored everywhere (interpret mode off

@@ -97,10 +97,13 @@ def main():
     #     stack is one leaf -> 11.3 GB transient, does NOT fit 16 GB.
     #     XLA may slice the gather per scan iteration, but that is
     #     scheduling-dependent and unproven at this scale;
-    #   - unrolled per-layer leaves: largest leaf 4096x14336 -> 0.35 GB
-    #     transient, fits comfortably.  8B therefore ships UNROLLED
-    #     under FSDP (the scan form exists for compile-service limits,
-    #     which pods without the tunnel do not share).
+    #   - unrolled per-layer leaves: the ceiling becomes the 128k-vocab
+    #     embedding (525M elems -> 3.15 GB transient; the largest
+    #     per-layer matrix is only 0.35 GB).  8B therefore ships
+    #     UNROLLED under FSDP, with the embedding ideally kept
+    #     vocab-sharded through its gather (a row lookup).  The scan
+    #     form exists for compile-service limits, which pods without
+    #     the tunnel do not share.
     gb = 1e9
 
     def table(local_, biggest_elems, opt_slots=1):
