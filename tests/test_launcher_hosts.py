@@ -115,3 +115,35 @@ def test_timeout_kills_hung_children(tmp_path):
     )
     assert proc.returncode == 124
     assert "timeout" in proc.stderr
+
+
+def test_islands_with_hosts_single_host():
+    """--islands N -H localhost:N: single host -> plain shm transport,
+    ranks spawned with the island env; the async example must pass."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.run.launcher",
+         "--islands", "4", "-H", "localhost:4", "--timeout", "400", "--",
+         sys.executable, os.path.join(REPO, "examples", "jax_async_islands.py"),
+         "--iters", "30", "--sleep", "0.001"],
+        capture_output=True, text=True, timeout=420, cwd=REPO,
+        env=env,
+    )
+    assert proc.returncode == 0, (
+        f"rc={proc.returncode}\nstdout:\n{proc.stdout[-2000:]}\n"
+        f"stderr:\n{proc.stderr[-2000:]}"
+    )
+    # under the launcher each rank IS a worker (no spawn-parent that
+    # prints the final OK); every rank reports its own convergence line
+    assert proc.stdout.count("consensus err") == 4, proc.stdout
+
+
+def test_islands_hosts_slot_mismatch_errors():
+    proc = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.run.launcher",
+         "--islands", "3", "-H", "localhost:2", "--", "true"],
+        capture_output=True, text=True, timeout=60, cwd=REPO,
+        env=dict(os.environ, PYTHONPATH=REPO),
+    )
+    assert proc.returncode == 2
+    assert "lists 2 slots" in proc.stderr
