@@ -1,0 +1,70 @@
+"""profiling.py: the intra-step attribution tools (slope timing, XLA
+cost summaries/deltas).  Values are hardware-dependent; these pin the
+contracts and the delta arithmetic."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bluefog_tpu import profiling
+
+
+def _mm(n):
+    @jax.jit
+    def f(x):
+        y = x
+        for _ in range(n):
+            y = jnp.tanh(y @ x)
+        return y
+
+    return f
+
+
+def test_slope_time_positive_and_ordered():
+    # 16x compute ratio + wide span + min-of-3: robust to CI load noise
+    x = jnp.ones((256, 256), jnp.float32)
+    t1 = profiling.slope_time(_mm(1), (x,), iters_lo=2, iters_hi=10,
+                              repeats=3)
+    t16 = profiling.slope_time(_mm(16), (x,), iters_lo=2, iters_hi=10,
+                               repeats=3)
+    assert t16 > t1 > 0
+
+
+def test_slope_time_fused_runs():
+    x = jnp.ones((128, 128), jnp.float32)
+    t = profiling.slope_time_fused(lambda y: jnp.tanh(y @ y), x,
+                                   iters_lo=2, iters_hi=16, repeats=2)
+    assert t > 0
+
+
+def test_slope_time_rejects_bad_span():
+    with pytest.raises(ValueError):
+        profiling.slope_time(lambda: 0, (), iters_lo=5, iters_hi=5)
+
+
+def test_segment_times_keys():
+    x = jnp.ones((32, 32), jnp.float32)
+    out = profiling.segment_times(
+        {"one": (_mm(1), (x,)), "four": (_mm(4), (x,))},
+        iters_lo=2, iters_hi=6, repeats=1,
+    )
+    assert set(out) == {"one", "four"}
+    assert all(v > 0 for v in out.values())
+
+
+def test_cost_summary_and_delta_flops():
+    x = jnp.ones((128, 128), jnp.float32)
+    c1 = profiling.cost_summary(_mm(1), (x,))
+    c3 = profiling.cost_summary(_mm(3), (x,))
+    assert c1["flops"] > 0
+    # each extra matmul adds 2*128^3 flops (+ the tanh elementwise)
+    delta = profiling.cost_delta(_mm(1), _mm(3), (x,), (x,))
+    added = delta["flops"]
+    assert added >= 2 * 2 * 128 ** 3
+    np.testing.assert_allclose(added, c3["flops"] - c1["flops"])
+
+
+def test_cost_summary_accepts_prejitted():
+    x = jnp.ones((16, 16), jnp.float32)
+    assert profiling.cost_summary(_mm(2), (x,))["flops"] > 0
