@@ -64,6 +64,36 @@ def _sync(loss):
     return v
 
 
+def measure_rtt(x, n: int = 3) -> float:
+    """The sync/fetch round-trip on an already-materialized array —
+    measured on the spot because it varies 3.5–200 ms between tunnel
+    sessions (benchmarks/peaks.py).  Shared by every benchmark that
+    subtracts it (bench.py, benchmarks/attention.py, benchmarks/llama.py)
+    so the protocols cannot drift apart."""
+    t0 = time.perf_counter()
+    for _ in range(n):
+        _sync(x)
+    return (time.perf_counter() - t0) / n
+
+
+def subtract_rtt(total: float, rt: float, iters: int,
+                 label: str = "") -> float:
+    """Per-iteration time with the RTT subtracted — GUARDED: when the
+    timed region does not dominate the RTT, the subtraction is jitter
+    (silently clamping would print absurd throughputs), so warn and
+    return the conservative unsubtracted figure instead."""
+    if total < 2.0 * rt:
+        print(
+            f"rtt-subtraction skipped{' (' + label + ')' if label else ''}: "
+            f"timed region {total * 1e3:.1f} ms < 2x RTT {rt * 1e3:.1f} ms "
+            "— raise iters for a trustworthy number (reported figure is "
+            "conservative, RTT included)",
+            file=sys.stderr,
+        )
+        return total / iters
+    return (total - rt) / iters
+
+
 def time_steps(step_fn, params, batch_stats, opt_state, batch, labels, warmup,
                iters):
     """Times per CALL; with steps_per_call=k each call is k real steps."""
@@ -81,18 +111,15 @@ def time_steps(step_fn, params, batch_stats, opt_state, batch, labels, warmup,
         )
     _sync(loss)
     # fetch round-trip latency, subtracted from the timed region below
-    t0 = time.perf_counter()
-    for _ in range(3):
-        _sync(loss)
-    rt = (time.perf_counter() - t0) / 3
+    # (shared guarded helper — see measure_rtt/subtract_rtt)
+    rt = measure_rtt(loss)
     t0 = time.perf_counter()
     for _ in range(iters):
         params, batch_stats, opt_state, loss, _ = step_fn(
             params, batch_stats, opt_state, batch, labels
         )
     _sync(loss)
-    dt = time.perf_counter() - t0 - rt
-    return max(dt, 1e-9) / iters
+    return subtract_rtt(time.perf_counter() - t0, rt, iters, "resnet")
 
 
 def main():

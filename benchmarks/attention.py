@@ -27,19 +27,25 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import _sync
+from bench import _sync, measure_rtt, subtract_rtt
 from bluefog_tpu.kernels.flash_attention import flash_attention
 from bluefog_tpu.models.transformer import dense_attention
 
 
 def timed(f, args, iters):
     out = f(*args)
-    _sync(out[0] if isinstance(out, tuple) else out)
+    first = out[0] if isinstance(out, tuple) else out
+    _sync(first)
+    # subtract the sync round-trip (3.5-200 ms per tunnel session):
+    # without this, small-S timings measure the RTT and ratios get
+    # pulled toward 1.  Guarded helper: if the timed region does not
+    # dominate the RTT it warns and reports the conservative figure.
+    rt = measure_rtt(first)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = f(*args)
     _sync(out[0] if isinstance(out, tuple) else out)
-    return (time.perf_counter() - t0) / iters
+    return subtract_rtt(time.perf_counter() - t0, rt, iters, "attention")
 
 
 def main():

@@ -27,7 +27,7 @@ import optax
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import _sync
+from bench import _sync, measure_rtt, subtract_rtt
 import bluefog_tpu as bf
 from bluefog_tpu import topology_util
 from bluefog_tpu.core import basics
@@ -146,18 +146,15 @@ def main():
         for _ in range(args.warmup):
             p, _, opt_state, loss, _ = step_fn(p, {}, opt_state, ids, ids)
         _sync(loss)
-        # measure + subtract the sync round-trip: the tunnel's fetch RTT
-        # varies 3.5-200 ms between sessions (benchmarks/peaks.py) and
-        # would otherwise ride on the timed region once
-        t0 = time.perf_counter()
-        for _ in range(3):
-            _sync(loss)
-        rt = (time.perf_counter() - t0) / 3
+        # measure + subtract the sync round-trip (shared guarded helper:
+        # the tunnel's fetch RTT varies 3.5-200 ms between sessions and
+        # would otherwise ride on the timed region once)
+        rt = measure_rtt(loss)
         t0 = time.perf_counter()
         for _ in range(args.iters):
             p, _, opt_state, loss, _ = step_fn(p, {}, opt_state, ids, ids)
         _sync(loss)
-        return max(time.perf_counter() - t0 - rt, 1e-9) / args.iters
+        return subtract_rtt(time.perf_counter() - t0, rt, args.iters, "llama")
 
     t_dec = timed(CommunicationType.neighbor_allreduce, ctx.plan)
     if n == 1 and cfg.get("remat"):
