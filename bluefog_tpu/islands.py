@@ -808,12 +808,16 @@ class DistributedWinPutOptimizer:
         ``_pack`` blocks until the device produces them — in THIS thread,
         so the main thread has already returned and dispatched more work.
         Returns the combined buffer per group."""
-        out = []
-        for g, (idxs, _, _, dt) in enumerate(self._groups):
-            name = f"{self.prefix}.{g}"
-            win_put(self._pack(leaf_refs, idxs, dt), name)
-            out.append(win_update(name))
-        return out
+        # its own timeline span: with BLUEFOG_TIMELINE set, the trace
+        # shows these rounds overlapping the main thread's device steps —
+        # the visual form of the reference's background-thread overlap
+        with timeline_context("overlap_gossip_round"):
+            out = []
+            for g, (idxs, _, _, dt) in enumerate(self._groups):
+                name = f"{self.prefix}.{g}"
+                win_put(self._pack(leaf_refs, idxs, dt), name)
+                out.append(win_update(name))
+            return out
 
     def _apply_pending(self, params):
         """Wait for the in-flight gossip round (if any) and swap its
