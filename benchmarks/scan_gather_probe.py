@@ -75,15 +75,30 @@ def main():
     hlo = step_fn.lower(
         {"master": master, "opt": (mu,)}, ids_s, ids_s).compile().as_text()
 
-    layers = 6
+    layers = lm.num_layers
+    # anchor on the opcode token, and accept tuple results (combined /
+    # async all-gather-start forms) — a naive `= (\S+) all-gather` match
+    # silently drops those and can flip the verdict to a false
+    # "sliced per layer"
+    op_re = re.compile(
+        r"^\s*(?:ROOT\s+)?%?\S+\s*=\s*(\([^)]*\)|\S+)\s*"
+        r"all-gather(?:-start|-done)?\(")
     shapes = Counter()
     for line in hlo.splitlines():
-        if "all-gather" in line and "=" in line:
-            m = re.search(r"=\s*(\S+)\s*all-gather", line)
-            if m:
-                shapes[m.group(1)] += 1
-    full_stack = [s for s in shapes if f",{layers}," in s
-                  or s.split("[")[-1].startswith(f"{layers},")]
+        m = op_re.match(line)
+        if m:
+            shapes[m.group(1)] += 1
+
+    def has_layer_axis(shape_str):
+        # the stacked leaf axis appears as the leading dim or right after
+        # the [machines] dim of any tensor in the (possibly tuple) result
+        for dims in re.findall(r"\[([\d,]+)\]", shape_str):
+            parts = [int(x) for x in dims.split(",") if x]
+            if parts[:1] == [layers] or parts[1:2] == [layers]:
+                return True
+        return False
+
+    full_stack = [s for s in shapes if has_layer_axis(s)]
     print("all-gather result shapes:")
     for s, c in shapes.most_common():
         tag = "  <-- FULL layer stack" if s in full_stack else ""
