@@ -107,3 +107,25 @@ def test_save_restore_scanned_llama_params(tmp_path):
     out0 = m.apply({"params": p}, ids)
     out1 = m.apply({"params": restored}, ids)
     np.testing.assert_allclose(np.asarray(out0), np.asarray(out1))
+
+
+def test_restore_like_preserves_wide_tuple_order(tmp_path):
+    """orbax's bare restore returns string-keyed dicts for tuple nodes;
+    with >= 10 children their lexicographic flatten order ('0','1','10',
+    '11',...,'2') would silently permute same-shaped leaves.
+    restore_like pairs structurally (item=), so order must survive."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bluefog_tpu import checkpoint
+
+    tree = {"opt": tuple(jnp.full((3,), float(i)) for i in range(12)),
+            "m": jnp.ones((2,))}
+    path = str(tmp_path / "wide")
+    checkpoint.save(path, tree)
+    template = jax.tree_util.tree_map(jnp.zeros_like, tree)
+    got = checkpoint.restore_like(path, template)
+    assert isinstance(got["opt"], tuple) and len(got["opt"]) == 12
+    for i, leaf in enumerate(got["opt"]):
+        np.testing.assert_array_equal(np.asarray(leaf), float(i))
