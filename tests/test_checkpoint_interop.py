@@ -114,18 +114,12 @@ def test_restore_like_preserves_wide_tuple_order(tmp_path):
     with >= 10 children their lexicographic flatten order ('0','1','10',
     '11',...,'2') would silently permute same-shaped leaves.
     restore_like pairs structurally (item=), so order must survive."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    from bluefog_tpu import checkpoint
-
     tree = {"opt": tuple(jnp.full((3,), float(i)) for i in range(12)),
             "m": jnp.ones((2,))}
     path = str(tmp_path / "wide")
-    checkpoint.save(path, tree)
+    ckpt.save(path, tree)
     template = jax.tree_util.tree_map(jnp.zeros_like, tree)
-    got = checkpoint.restore_like(path, template)
+    got = ckpt.restore_like(path, template)
     assert isinstance(got["opt"], tuple) and len(got["opt"]) == 12
     for i, leaf in enumerate(got["opt"]):
         np.testing.assert_array_equal(np.asarray(leaf), float(i))
