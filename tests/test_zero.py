@@ -351,3 +351,51 @@ def test_zero_state_checkpoint_resume(devices, tmp_path):
     for k in ("w1", "w2"):
         np.testing.assert_array_equal(np.asarray(got[k]),
                                       np.asarray(want[k]))
+
+
+@pytest.mark.parametrize("variant", ["packed", "fsdp"])
+def test_zero_single_machine_no_gossip(devices, variant):
+    """machines=1 (flat ZeRO, no machine axis to gossip over — the common
+    non-decentralized use): state shards over all 8 devices and the step
+    matches plain data-parallel SGD+momentum."""
+    from bluefog_tpu.parallel.zero import (
+        make_fsdp_gossip_train_step,
+        make_zero_gossip_train_step,
+    )
+
+    bf.shutdown()
+    bf.init(local_size=8)
+    ctx = basics.context()
+    assert ctx.hier_mesh.devices.shape == (1, 8)
+    apply_fn, loss_fn, params = _model()
+    make = (make_zero_gossip_train_step if variant == "packed"
+            else make_fsdp_gossip_train_step)
+    init_fn, step_fn, params_of = make(
+        apply_fn, loss_fn, ctx.hier_mesh, None,
+        learning_rate=LR, momentum=MOM, compute_dtype=jnp.float32,
+    )
+    state = init_fn(params)
+    rng = np.random.default_rng(9)
+    x = rng.normal(size=(1, 8, 4, 6)).astype(np.float32)
+    y = rng.normal(size=(1, 8, 4, 3)).astype(np.float32)
+
+    # ground truth: single replica, grads averaged over all 8 batches
+    def loss_all(p):
+        return sum(loss_fn(apply_fn(p, jnp.asarray(x[0, l])),
+                           jnp.asarray(y[0, l])) for l in range(8)) / 8
+
+    g = jax.grad(loss_all)(params)
+    ref = jax.tree_util.tree_map(lambda w, g_: w - LR * g_, params, g)
+
+    if variant == "packed":
+        state, loss = step_fn(state, jnp.asarray(x), jnp.asarray(y))
+    else:
+        state, loss = step_fn(
+            state, jnp.asarray(x.reshape(1, 32, 6)),
+            jnp.asarray(y.reshape(1, 32, 3)))
+    assert np.isfinite(float(loss))
+    got = params_of(state)
+    for k in ("w1", "w2"):
+        np.testing.assert_allclose(
+            np.asarray(got[k], np.float32), np.asarray(ref[k], np.float32),
+            rtol=2e-5, atol=2e-5)
