@@ -88,3 +88,15 @@ def test_zero_gossip_example():
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "zero gossip demo OK" in proc.stdout, proc.stdout
+
+
+def test_interactive_islands_example():
+    """The ibfrun-twin demo: three 'cells' against live island workers."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples", "jax_interactive_islands.py")],
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "interactive islands demo OK" in proc.stdout, proc.stdout
