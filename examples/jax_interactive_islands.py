@@ -13,8 +13,6 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as np
-
 from bluefog_tpu.run.interactive_islands import IslandSession
 
 
@@ -34,12 +32,17 @@ def cell_create(rank, size):
 def cell_gossip(rank, size, rounds):
     from bluefog_tpu import islands
 
-    out = None
+    # the synchronous schedule (cf. islands.settle / the gossip tests):
+    # deposit, barrier, combine, barrier — everyone's round-k deposit
+    # lands BEFORE anyone combines, so the values are deterministic at
+    # any rank count
+    cur = islands.win_sync("demo")
     for _ in range(rounds):
-        out = islands.win_update("demo")
-        islands.win_put(out, "demo")
+        islands.win_put(cur, "demo")
         islands.barrier()
-    return float(out.mean())
+        cur = islands.win_update("demo")
+        islands.barrier()
+    return float(cur.mean())
 
 
 def cell_cleanup(rank, size):
