@@ -92,8 +92,21 @@ def parse_hosts(spec: str) -> list:
     return out
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _local_names() -> frozenset:
+    # computed once: getfqdn can touch DNS
+    return frozenset({"localhost", "127.0.0.1", "::1",
+                      socket.gethostname(), socket.getfqdn()})
+
+
 def _is_local_host(host: str) -> bool:
-    return host in ("localhost", "127.0.0.1", "::1", socket.gethostname())
+    """True for every name this machine answers to — including its FQDN,
+    so `-H thismachine.example.com:4,...` forks locally instead of
+    ssh-ing to itself."""
+    return host in _local_names()
 
 
 def env_whitelist(env: dict) -> dict:
@@ -430,8 +443,13 @@ def _run_islands(cmd, env, nranks: int, job, hosts, timeout: float) -> int:
             if multi_host:
                 child_env["BLUEFOG_ISLAND_HOSTMAP"] = ",".join(by_rank)
                 child_env["BLUEFOG_ISLAND_COORD"] = coord
-                if not _is_local_host(by_rank[r]):
-                    child_env["BLUEFOG_ISLAND_HOST"] = by_rank[r]
+                # EVERY rank must advertise an address its remote peers
+                # can dial: remote ranks their host name, locally-forked
+                # ranks this machine's reachable name — never the
+                # loopback the transport would otherwise default to
+                child_env["BLUEFOG_ISLAND_HOST"] = (
+                    socket.getfqdn() if _is_local_host(by_rank[r])
+                    else by_rank[r])
             ranks.append(_spawn_rank(by_rank[r], cmd, child_env, tag, r))
         try:
             code = _supervise(ranks, timeout)

@@ -151,3 +151,51 @@ def test_islands_hosts_slot_mismatch_errors():
     )
     assert proc.returncode == 2
     assert "lists 2 slots" in proc.stderr
+
+
+def test_is_local_host_matches_own_names():
+    import socket
+
+    from bluefog_tpu.run.launcher import _is_local_host
+
+    assert _is_local_host("localhost")
+    assert _is_local_host("127.0.0.1")
+    assert _is_local_host(socket.gethostname())
+    assert _is_local_host(socket.getfqdn())
+    assert not _is_local_host("definitely-not-this-machine.example.com")
+
+
+def test_islands_multihost_advertises_reachable_host(monkeypatch):
+    """In a multi-host islands launch EVERY rank gets a dialable
+    BLUEFOG_ISLAND_HOST: remote ranks their host name, locally-forked
+    ranks this machine's reachable name — never unset/loopback (a
+    locally-forked head advertising 127.0.0.1 would strand remote
+    peers)."""
+    import socket
+
+    from bluefog_tpu.run import launcher
+
+    seen = []
+
+    class _FakeProc:
+        pid = 0
+
+        def poll(self):
+            return 0
+
+    def fake_spawn(host, cmd, child_env, tag, r):
+        seen.append((r, host, dict(child_env)))
+        return launcher._Rank(_FakeProc(), host)
+
+    monkeypatch.setattr(launcher, "_spawn_rank", fake_spawn)
+    monkeypatch.setattr(launcher, "_supervise", lambda ranks, t: 0)
+    monkeypatch.setattr(launcher, "_cleanup_island_segments",
+                        lambda job, by_rank: None)
+    rc = launcher._run_islands(
+        ["true"], {}, 2, "jobx", [("localhost", 1), ("nodeb", 1)], 0.0)
+    assert rc == 0
+    envs = {r: e for r, _, e in seen}
+    assert envs[0]["BLUEFOG_ISLAND_HOST"] == socket.getfqdn()
+    assert envs[1]["BLUEFOG_ISLAND_HOST"] == "nodeb"
+    assert envs[0]["BLUEFOG_ISLAND_HOSTMAP"] == "localhost,nodeb"
+    assert "BLUEFOG_ISLAND_COORD" in envs[0]
