@@ -45,9 +45,16 @@ PRESETS = {
     # 3 f32 copies = 12.6 GB, AdamW's 4 would not fit single-chip
     # scan_layers: one block body in the HLO — 24 unrolled 1B-scale blocks
     # crash the remote-compile service (measured round 2)
+    # head_chunks: chunked LM loss — the full [B,T,32k] f32 logits (+their
+    # backward cotangent) are ~2.1 GB at B=4; chunking frees that buffer.
+    # Measured (same session): chunked == full-logits throughput at B=4
+    # (13.08k vs 13.02k tok/s); batch 8 STILL OOMs (by 0.6 GB: the f32
+    # params+grads+momentum = 12.6 GB dominate, not the head); batch 6
+    # is 12% SLOWER (11.5k — non-power-of-2 batch tiles the MXU badly).
+    # B=4 + chunked head stands as the single-chip config.
     "1b": dict(vocab=32000, hidden=1792, layers=24, heads=14, dff=4864,
                seq=2048, batch=4, remat=True, scan_layers=True,
-               optimizer="sgdm"),
+               optimizer="sgdm", head_chunks=8),
     "tiny": dict(vocab=256, hidden=64, layers=2, heads=4, dff=128,
                  seq=128, batch=2),
 }
@@ -74,6 +81,9 @@ def main():
     ap.add_argument("--kv-heads", type=int, default=0,
                     help="grouped-query attention: kv head count "
                     "(0 = MHA; must divide the preset's heads)")
+    ap.add_argument("--head-chunks", type=int, default=-1,
+                    help="chunked LM loss: sequence chunks for the head "
+                    "(-1 = preset default, 0/1 = full logits)")
     args = ap.parse_args()
     cfg = dict(PRESETS[args.preset])
     if args.batch:
@@ -90,9 +100,12 @@ def main():
     bf.set_topology(topology_util.ExponentialTwoGraph(n))
     ctx = basics.context()
 
+    head_chunks = (cfg.get("head_chunks", 0) if args.head_chunks < 0
+                   else args.head_chunks)
     model = LlamaLM(
         vocab_size=cfg["vocab"], hidden_size=cfg["hidden"],
         num_layers=cfg["layers"], num_heads=cfg["heads"], dff=cfg["dff"],
+        head_chunks=head_chunks,
         remat=cfg.get("remat", False),
         remat_policy=args.remat_policy,
         num_kv_heads=args.kv_heads or None,
@@ -122,13 +135,22 @@ def main():
     rng = np.random.default_rng(0)
     ids = jnp.asarray(rng.integers(0, cfg["vocab"], size=(n, B, T)), jnp.int32)
 
-    def lm_loss(logits, labels):
-        return optax.softmax_cross_entropy_with_integer_labels(
-            logits[:, :-1], labels[:, 1:]
-        ).mean()
+    if head_chunks > 1:
+        # the model computes the (chunked) scalar loss itself; the full
+        # logits never exist on the device
+        def lm_loss(out, labels):
+            return out
 
-    def lm_apply(variables, x):
-        return model.apply(variables, x)
+        def lm_apply(variables, x):
+            return model.apply(variables, x, labels=x)
+    else:
+        def lm_loss(logits, labels):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], labels[:, 1:]
+            ).mean()
+
+        def lm_apply(variables, x):
+            return model.apply(variables, x)
 
     opt = {
         "adamw": lambda: optax.adamw(3e-4),

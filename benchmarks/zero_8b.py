@@ -49,11 +49,15 @@ def main():
         ctx.hier_mesh.devices.shape)
     bf.set_machine_topology(topology_util.ExponentialTwoGraph(machines))
 
+    # head_chunks: at a 128k vocab the full [B,T,V] f32 logits + their
+    # backward cotangent are ~2.1 GB/batch-row of transients the memory
+    # table would otherwise have to carry; the chunked LM loss caps the
+    # head transient at [B, T/16, V] = 66 MB
     lm = LlamaLM(
         vocab_size=CFG["vocab"], hidden_size=CFG["hidden"],
         num_layers=CFG["layers"], num_heads=CFG["heads"],
         num_kv_heads=CFG["kv_heads"], dff=CFG["dff"],
-        remat=True, scan_layers=True,
+        remat=True, scan_layers=True, head_chunks=16,
     )
     B, T = CFG["batch"], CFG["seq"]
     ids0 = jnp.ones((B, T), jnp.int32)
@@ -64,12 +68,12 @@ def main():
                    for l in jax.tree_util.tree_leaves(p_shapes))
 
     def apply_fn(p, ids):
-        return lm.apply({"params": p}, ids)
+        # LM pretraining: inputs are their own labels; the model returns
+        # the (chunked) scalar loss — full logits never materialize
+        return lm.apply({"params": p}, ids, labels=ids)
 
-    def loss_fn(logits, labels):
-        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
-        return -jnp.mean(
-            jnp.take_along_axis(logp, labels[:, 1:, None], axis=-1))
+    def loss_fn(out, labels):
+        return out
 
     init_fn, step_fn, _ = make_fsdp_gossip_train_step(
         apply_fn, loss_fn, ctx.hier_mesh, ctx.machine_plan,

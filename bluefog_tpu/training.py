@@ -122,7 +122,13 @@ def make_decentralized_train_step(
 
         updates, new_os = tx.update(grads, os_, p)
         new_p = optax.apply_updates(p, updates)
-        acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        if logits.ndim >= 2:
+            acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        else:
+            # apply_fn returned a scalar loss directly (e.g. the chunked
+            # LM head, where full logits never exist) — NaN marks the
+            # accuracy "not computed" rather than a measured 0%
+            acc = jnp.full_like(loss, jnp.nan)
         # re-attach the rank-major axis
         expand = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
         new_os_out = jax.tree_util.tree_map(

@@ -44,10 +44,12 @@ def test_slope_time_rejects_bad_span():
 
 
 def test_segment_times_keys():
-    x = jnp.ones((32, 32), jnp.float32)
+    # big enough work + best-of-3 repeats that the slope stays positive
+    # even when CI shares this 1-core host with another build job
+    x = jnp.ones((256, 256), jnp.float32)
     out = profiling.segment_times(
         {"one": (_mm(1), (x,)), "four": (_mm(4), (x,))},
-        iters_lo=2, iters_hi=6, repeats=1,
+        iters_lo=2, iters_hi=10, repeats=3,
     )
     assert set(out) == {"one", "four"}
     assert all(v > 0 for v in out.values())

@@ -317,3 +317,51 @@ def test_llama_gqa_param_savings_and_equivalence():
     p_s = scan_gqa.init(jax.random.PRNGKey(0), ids)["params"]
     out = scan_gqa.apply({"params": p_s}, ids)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_llama_head_chunks_matches_full():
+    """The chunked LM loss (head_chunks>1: lax.scan + jax.checkpoint,
+    full logits never materialized) must equal the full-logits loss —
+    value AND gradients — and both must equal the external
+    optax-style shifted CE the benchmark uses."""
+    import optax
+    from bluefog_tpu.models.transformer import LlamaLM
+
+    kw = dict(vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
+              dff=64, dtype=jnp.float32)
+    m_full = LlamaLM(**kw)
+    m_chunk = LlamaLM(**kw, head_chunks=4)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, 97, size=(2, 16)), jnp.int32
+    )
+    p = m_full.init(jax.random.PRNGKey(0), ids)["params"]
+
+    # external reference: CE over full logits, the benchmark's lm_loss
+    logits = m_full.apply({"params": p}, ids)
+    ref = optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1], ids[:, 1:]
+    ).mean()
+
+    l_full, g_full = jax.value_and_grad(
+        lambda p: m_full.apply({"params": p}, ids, labels=ids)
+    )(p)
+    l_chunk, g_chunk = jax.value_and_grad(
+        lambda p: m_chunk.apply({"params": p}, ids, labels=ids)
+    )(p)
+    np.testing.assert_allclose(np.asarray(l_full), np.asarray(ref), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(l_chunk), np.asarray(ref), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(g_full),
+                    jax.tree_util.tree_leaves(g_chunk)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_llama_head_kernel_pytree_path_unchanged():
+    """The explicit _HeadKernel must keep the LM head at Dense_0/kernel
+    with the nn.Dense shape/dtype (checkpoint compatibility)."""
+    from bluefog_tpu.models.transformer import LlamaLM
+
+    m = LlamaLM(vocab_size=97, hidden_size=32, num_layers=1, num_heads=4,
+                dff=64, dtype=jnp.float32)
+    p = m.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+    assert p["Dense_0"]["kernel"].shape == (32, 97)
+    assert p["Dense_0"]["kernel"].dtype == jnp.float32
