@@ -34,12 +34,19 @@ from bluefog_tpu.core import basics
 from bluefog_tpu.kernels import make_flash_attention_fn
 from bluefog_tpu.models.transformer import LlamaLM
 from bluefog_tpu.optim import CommunicationType
-from bluefog_tpu.training import make_decentralized_train_step, replicate_for_mesh
+from bluefog_tpu.training import (
+    make_decentralized_train_step,
+    make_lm_loss_fns,
+    replicate_for_mesh,
+)
 
 PRESETS = {
-    # ~125M-class: GPT-2-small-shaped Llama, flash attention
+    # ~125M-class: GPT-2-small-shaped Llama, flash attention.
+    # head_chunks: chunked LM loss measured FASTER here too (+3.9%
+    # same-session, 72.1k vs 69.4k tok/s) — the freed [B,T,32k] f32
+    # logits traffic outweighs the head recompute even at 134M
     "small": dict(vocab=32000, hidden=768, layers=12, heads=12, dff=2048,
-                  seq=2048, batch=8),
+                  seq=2048, batch=8, head_chunks=8),
     # ~1.05B (BASELINE config #5 feasibility on one 16 GB chip): bf16
     # compute, per-block remat, momentum-SGD — params+momentum+grads are
     # 3 f32 copies = 12.6 GB, AdamW's 4 would not fit single-chip
@@ -50,8 +57,10 @@ PRESETS = {
     # Measured (same session): chunked == full-logits throughput at B=4
     # (13.08k vs 13.02k tok/s); batch 8 STILL OOMs (by 0.6 GB: the f32
     # params+grads+momentum = 12.6 GB dominate, not the head); batch 6
-    # is 12% SLOWER (11.5k — non-power-of-2 batch tiles the MXU badly).
-    # B=4 + chunked head stands as the single-chip config.
+    # is 12% SLOWER (11.5k — non-power-of-2 batch tiles the MXU badly);
+    # batch 8 + --optimizer sgdm_bf16 (bf16 momentum frees 2.1 GB) FITS
+    # but is throughput-NEUTRAL (13.11k) — B=4's matmuls already
+    # saturate the MXU.  B=4 + chunked head + f32 sgdm stands.
     "1b": dict(vocab=32000, hidden=1792, layers=24, heads=14, dff=4864,
                seq=2048, batch=4, remat=True, scan_layers=True,
                optimizer="sgdm", head_chunks=8),
@@ -84,10 +93,16 @@ def main():
     ap.add_argument("--head-chunks", type=int, default=-1,
                     help="chunked LM loss: sequence chunks for the head "
                     "(-1 = preset default, 0/1 = full logits)")
+    ap.add_argument("--optimizer", default=None,
+                    choices=[None, "adamw", "sgdm", "sgdm_bf16"],
+                    help="override the preset optimizer (sgdm_bf16 = "
+                    "bf16 momentum trace, frees 2.1 GB at 1B)")
     args = ap.parse_args()
     cfg = dict(PRESETS[args.preset])
     if args.batch:
         cfg["batch"] = args.batch
+    if args.optimizer:
+        cfg["optimizer"] = args.optimizer
     if args.remat_policy and not cfg.get("remat"):
         # LlamaLM only consults remat_policy under remat=True; silently
         # attributing a number to a policy that never applied would
@@ -135,26 +150,16 @@ def main():
     rng = np.random.default_rng(0)
     ids = jnp.asarray(rng.integers(0, cfg["vocab"], size=(n, B, T)), jnp.int32)
 
-    if head_chunks > 1:
-        # the model computes the (chunked) scalar loss itself; the full
-        # logits never exist on the device
-        def lm_loss(out, labels):
-            return out
-
-        def lm_apply(variables, x):
-            return model.apply(variables, x, labels=x)
-    else:
-        def lm_loss(logits, labels):
-            return optax.softmax_cross_entropy_with_integer_labels(
-                logits[:, :-1], labels[:, 1:]
-            ).mean()
-
-        def lm_apply(variables, x):
-            return model.apply(variables, x)
+    lm_apply, lm_loss = make_lm_loss_fns(model)
 
     opt = {
         "adamw": lambda: optax.adamw(3e-4),
         "sgdm": lambda: optax.sgd(3e-4, momentum=0.9),
+        # mixed-precision momentum (optax's own accumulator_dtype): the
+        # f32 trace is 4.2 GB at 1B — halving it is what admits batch 8
+        # on a 16 GB chip.  Opt-in: bf16 accumulation changes numerics.
+        "sgdm_bf16": lambda: optax.sgd(
+            3e-4, momentum=0.9, accumulator_dtype=jnp.bfloat16),
     }[cfg.get("optimizer", "adamw")]()
 
     def timed(comm, plan):

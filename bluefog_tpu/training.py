@@ -34,11 +34,49 @@ from bluefog_tpu.optim import (
 )
 from bluefog_tpu.timeline import timeline_context
 
-__all__ = ["make_decentralized_train_step", "replicate_for_mesh"]
+__all__ = [
+    "make_decentralized_train_step",
+    "make_lm_loss_fns",
+    "replicate_for_mesh",
+]
 
 
 def softmax_cross_entropy(logits, labels):
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def make_lm_loss_fns(model):
+    """(apply_fn, loss_fn) for LM pretraining with a ``LlamaLM``-style
+    model where inputs are their own labels.  The chunked-vs-full choice
+    is read off ``model.head_chunks`` — the one place it is configured.
+
+    With ``head_chunks > 1`` the model computes the chunked scalar loss
+    itself (``apply(variables, ids, labels=ids)`` — the full
+    ``[B, T, vocab]`` logits never materialize) and ``loss_fn`` is the
+    identity; otherwise the model returns logits and ``loss_fn`` is the
+    standard shifted cross-entropy.  One definition shared by
+    ``benchmarks/llama.py`` and ``examples/jax_llama_pretrain.py`` so the
+    chunked-loss contract cannot drift between them.
+    """
+    if getattr(model, "head_chunks", 0) > 1:
+
+        def apply_fn(variables, ids):
+            return model.apply(variables, ids, labels=ids)
+
+        def loss_fn(out, labels):
+            return out
+
+    else:
+
+        def apply_fn(variables, ids):
+            return model.apply(variables, ids)
+
+        def loss_fn(logits, labels):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], labels[:, 1:]
+            ).mean()
+
+    return apply_fn, loss_fn
 
 
 def make_decentralized_train_step(

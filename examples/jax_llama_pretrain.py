@@ -35,7 +35,11 @@ from bluefog_tpu.parallel.ring_attention import (
     stripe_blocks,
     striped_positions,
 )
-from bluefog_tpu.training import make_decentralized_train_step, replicate_for_mesh
+from bluefog_tpu.training import (
+    make_decentralized_train_step,
+    make_lm_loss_fns,
+    replicate_for_mesh,
+)
 
 
 def make_stream(rng, vocab, length):
@@ -66,9 +70,22 @@ def main():
         help="flash = Pallas flash-attention kernel "
         "(ring-flash hops under --seq-parallel)",
     )
+    parser.add_argument(
+        "--head-chunks", type=int, default=0,
+        help="chunked LM loss: full [B,T,vocab] logits never "
+        "materialize (the large-vocab/large-batch memory saver; "
+        "must divide --seq-len)",
+    )
     args = parser.parse_args()
     if args.striped and not args.seq_parallel:
         parser.error("--striped is a sequence-layout option: add --seq-parallel")
+    if args.head_chunks > 1 and args.seq_parallel:
+        # the seq-parallel path computes its loss over sequence SHARDS
+        # (per-shard logits are already 1/n-sized and the striped form
+        # needs the cross-stripe psum); silently ignoring the flag would
+        # misattribute the run
+        parser.error("--head-chunks applies to the data-parallel path "
+                     "only (the seq-parallel loss is computed per shard)")
 
     bf.init()
     n = bf.size()
@@ -88,19 +105,13 @@ def main():
     model = LlamaLM(
         vocab_size=args.vocab, hidden_size=args.hidden, num_layers=args.layers,
         num_heads=4, dff=args.hidden * 3, dtype=jnp.float32,
-        attention_fn=attention_fn,
+        attention_fn=attention_fn, head_chunks=args.head_chunks,
     )
     ids0 = jnp.zeros((1, args.seq_len), jnp.int32)
     params0 = model.init(jax.random.PRNGKey(0), ids0)["params"]
     params = replicate_for_mesh(params0, n)
 
-    def lm_apply(variables, ids):
-        return model.apply(variables, ids)
-
-    def lm_loss(logits, labels):
-        return optax.softmax_cross_entropy_with_integer_labels(
-            logits[:, :-1], labels[:, 1:]
-        ).mean()
+    lm_apply, lm_loss = make_lm_loss_fns(model)
 
     init_fn, step_fn = make_decentralized_train_step(
         lm_apply,
