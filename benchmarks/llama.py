@@ -93,10 +93,18 @@ def main():
     ap.add_argument("--head-chunks", type=int, default=-1,
                     help="chunked LM loss: sequence chunks for the head "
                     "(-1 = preset default, 0/1 = full logits)")
+    ap.add_argument("--head-bf16", action="store_true",
+                    help="LM head matmul with bf16 operands / f32 "
+                    "accumulation (custom-VJP path; measured NEUTRAL "
+                    "at 1B and -3%% at 134M on the v5e, where default "
+                    "f32 matmul already runs near the bf16 rate)")
     ap.add_argument("--optimizer", default=None,
-                    choices=[None, "adamw", "sgdm", "sgdm_bf16"],
+                    choices=[None, "adamw", "sgdm", "sgdm_bf16",
+                             "adafactor"],
                     help="override the preset optimizer (sgdm_bf16 = "
-                    "bf16 momentum trace, frees 2.1 GB at 1B)")
+                    "bf16 momentum trace, frees 2.1 GB at 1B; "
+                    "adafactor = factored second moment, adaptive "
+                    "updates at ~zero state cost)")
     args = ap.parse_args()
     cfg = dict(PRESETS[args.preset])
     if args.batch:
@@ -121,6 +129,7 @@ def main():
         vocab_size=cfg["vocab"], hidden_size=cfg["hidden"],
         num_layers=cfg["layers"], num_heads=cfg["heads"], dff=cfg["dff"],
         head_chunks=head_chunks,
+        head_dtype=jnp.bfloat16 if args.head_bf16 else jnp.float32,
         remat=cfg.get("remat", False),
         remat_policy=args.remat_policy,
         num_kv_heads=args.kv_heads or None,
@@ -160,6 +169,12 @@ def main():
         # on a 16 GB chip.  Opt-in: bf16 accumulation changes numerics.
         "sgdm_bf16": lambda: optax.sgd(
             3e-4, momentum=0.9, accumulator_dtype=jnp.bfloat16),
+        # the idiomatic TPU big-model optimizer (T5/PaLM lineage): the
+        # second moment is FACTORED (row+col accumulators, ~KB per
+        # matrix instead of a param-sized f32 copy), so at 1B the
+        # optimizer state is ~8 MB where AdamW needs 8.4 GB — adaptive
+        # learning rates at momentum-SGD's memory cost
+        "adafactor": lambda: optax.adafactor(3e-4),
     }[cfg.get("optimizer", "adamw")]()
 
     def timed(comm, plan):

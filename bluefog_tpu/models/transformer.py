@@ -218,7 +218,84 @@ class _ScannedDecoderBlock(nn.Module):
         return x, None
 
 
-def chunked_softmax_cross_entropy(hidden, kernel, labels, num_chunks):
+@jax.custom_vjp
+def _bf16_matmul_f32_acc(x, kernel):
+    """bf16-input matmul with f32 accumulation IN BOTH DIRECTIONS.
+
+    Without the custom VJP, jax differentiates the forward's
+    ``dot(bf16, bf16, preferred=f32)`` into backward dots that mix the
+    f32 cotangent with the bf16 operands — dtype promotion turns those
+    back into f32 matmuls AND re-casts the operands per use (measured:
+    a naive bf16 head was 6% SLOWER end to end than the f32 head at
+    134M).  Here the cotangent is rounded to bf16 (the standard
+    mixed-precision training contract: every matmul operand is bf16,
+    every accumulator f32), so fwd, dx, and dW all run 1-pass at full
+    MXU rate, with dW emerging f32 for the optimizer.
+
+    Measured verdict on the v5e (docs/STATUS.md): even with this VJP the
+    bf16 head is NEUTRAL at 1B and −3% at 134M vs the f32 head — XLA's
+    default-precision f32 matmul already sustains 153–166 TF/s (~80% of
+    the bf16 rate, `benchmarks/peaks.py`), so the rate gain cannot pay
+    for the per-chunk operand casts.  f32 stays the default; the option
+    exists for hardware where true-f32 matmul is actually slow.
+    """
+    y, _ = _bf16_matmul_f32_acc_fwd(x, kernel)
+    return y
+
+
+def _bf16_matmul_f32_acc_fwd(x, kernel):
+    xb = x.astype(jnp.bfloat16)
+    kb = kernel.astype(jnp.bfloat16)
+    y = jax.lax.dot_general(
+        xb, kb, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return y, (xb, kb)
+
+
+def _bf16_matmul_f32_acc_bwd(res, g):
+    xb, kb = res
+    gb = g.astype(jnp.bfloat16)
+    nbatch = gb.ndim - 1
+    # dx[..., d] = g[..., v] @ kernel[d, v]^T
+    dx = jax.lax.dot_general(
+        gb, kb, (((nbatch,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    # dW[d, v] = sum over batch dims of x[..., d] * g[..., v]
+    batch_axes = tuple(range(nbatch))
+    dw = jax.lax.dot_general(
+        xb, gb, ((batch_axes, batch_axes), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return dx, dw
+
+
+_bf16_matmul_f32_acc.defvjp(_bf16_matmul_f32_acc_fwd, _bf16_matmul_f32_acc_bwd)
+
+
+def _head_matmul(x, kernel, dtype):
+    """Logits matmul with f32 ACCUMULATION/output regardless of ``dtype``.
+
+    ``dtype=float32`` reproduces the ``nn.Dense(dtype=f32)`` head (XLA
+    lowers default-precision f32 matmul onto the MXU at 153–166 TF/s on
+    the v5e — near the bf16 rate).  ``dtype=bfloat16`` rounds matmul
+    operands — including the backward cotangent, via the custom VJP
+    above — to bf16; accumulators and logits stay f32, so the
+    downstream logsumexp/CE numerics are intact.  See the VJP docstring
+    for the measured (neutral-to-negative on v5e) verdict.
+    """
+    if dtype == jnp.bfloat16:
+        return _bf16_matmul_f32_acc(x, kernel)
+    return jax.lax.dot_general(
+        x.astype(dtype), kernel.astype(dtype),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def chunked_softmax_cross_entropy(hidden, kernel, labels, num_chunks,
+                                  dtype=jnp.float32):
     """Next-token cross-entropy WITHOUT materializing the full logits.
 
     The LM-head logits ``[B, T, vocab]`` in f32 are the single biggest
@@ -261,7 +338,7 @@ def chunked_softmax_cross_entropy(hidden, kernel, labels, num_chunks):
     @jax.checkpoint
     def body(carry, xyw):
         xc, yc, wc = xyw
-        logits = xc.astype(jnp.float32) @ kernel  # [B, tc, V] — the peak
+        logits = _head_matmul(xc, kernel, dtype)  # [B, tc, V] — the peak
         lse = jax.nn.logsumexp(logits, axis=-1)
         tgt = jnp.take_along_axis(logits, yc[..., None], axis=-1)[..., 0]
         # per-chunk outputs instead of a scalar carry: under shard_map a
@@ -309,6 +386,7 @@ class LlamaLM(nn.Module):
     scan_layers: bool = False  # lax.scan over stacked layers: O(1)-size HLO
     num_kv_heads: Optional[int] = None  # GQA: kv heads < query heads
     head_chunks: int = 0  # >1: chunked LM loss, never materializes full logits
+    head_dtype: Any = jnp.float32  # bf16: 1-pass MXU head, f32 accumulation
 
     @nn.compact
     def __call__(self, input_ids, positions=None, labels=None):
@@ -343,12 +421,12 @@ class LlamaLM(nn.Module):
         x = RMSNorm(dtype=jnp.float32)(x)
         kernel = _HeadKernel(self.vocab_size, name="Dense_0")(self.hidden_size)
         if labels is None:
-            return x @ kernel  # f32 logits, same numerics as the Dense head
+            return _head_matmul(x, kernel, self.head_dtype)  # f32 logits
         if self.head_chunks > 1:
             return chunked_softmax_cross_entropy(
-                x, kernel, labels, self.head_chunks
+                x, kernel, labels, self.head_chunks, dtype=self.head_dtype
             )
-        logits = x @ kernel
+        logits = _head_matmul(x, kernel, self.head_dtype)
         lse = jax.nn.logsumexp(logits[:, :-1], axis=-1)
         tgt = jnp.take_along_axis(
             logits[:, :-1], labels[:, 1:, None], axis=-1

@@ -365,3 +365,36 @@ def test_llama_head_kernel_pytree_path_unchanged():
     p = m.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
     assert p["Dense_0"]["kernel"].shape == (32, 97)
     assert p["Dense_0"]["kernel"].dtype == jnp.float32
+
+
+def test_llama_head_bf16_close_to_f32():
+    """head_dtype=bf16 rounds only the matmul INPUTS (f32 accumulation
+    via preferred_element_type): the loss must track the f32 head to
+    bf16-rounding tolerance, for both the full and chunked paths."""
+    from bluefog_tpu.models.transformer import LlamaLM
+
+    kw = dict(vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
+              dff=64, dtype=jnp.float32)
+    ids = jnp.asarray(
+        np.random.default_rng(1).integers(0, 97, size=(2, 16)), jnp.int32
+    )
+    m_f32 = LlamaLM(**kw)
+    p = m_f32.init(jax.random.PRNGKey(0), ids)["params"]
+    l_ref, g_ref = jax.value_and_grad(
+        lambda p: m_f32.apply({"params": p}, ids, labels=ids))(p)
+    for hc in (0, 4):
+        m_bf16 = LlamaLM(**kw, head_chunks=hc, head_dtype=jnp.bfloat16)
+        got, g = jax.value_and_grad(
+            lambda p: m_bf16.apply({"params": p}, ids, labels=ids))(p)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(l_ref), rtol=5e-3
+        )
+        # the custom VJP rounds matmul operands (incl. the cotangent) to
+        # bf16; grads must stay f32-dtyped and track the f32 head to
+        # bf16-rounding tolerance
+        for a, b in zip(jax.tree_util.tree_leaves(g),
+                        jax.tree_util.tree_leaves(g_ref)):
+            assert a.dtype == b.dtype
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-2, rtol=2e-2
+            )
