@@ -48,6 +48,9 @@ PRESETS = {
 
 def build_grad_fn(cfg, layers, on_tpu, head_bf16, attn):
     attention_fn = {
+        # off-TPU there is no Pallas path: fall back to dense and SAY so
+        # in the JSON (effective_attn) instead of mislabeling a dense run
+        # as flash (r3 advisor finding)
         "flash": make_flash_attention_fn() if on_tpu else None,
         "dense": None,
         # shape-correct pass-through: measures the block with the
@@ -55,6 +58,7 @@ def build_grad_fn(cfg, layers, on_tpu, head_bf16, attn):
         # so flash-share = per_block(flash) - per_block(none)
         "none": lambda q, k, v: v,
     }[attn]
+    effective_attn = attn if (attn != "flash" or on_tpu) else "dense"
     model = LlamaLM(
         vocab_size=cfg["vocab"], hidden_size=cfg["hidden"],
         num_layers=layers, num_heads=cfg["heads"], dff=cfg["dff"],
@@ -77,7 +81,7 @@ def build_grad_fn(cfg, layers, on_tpu, head_bf16, attn):
     jax.block_until_ready(grad_step(params, ids))
     n_params = sum(int(np.prod(a.shape))
                    for a in jax.tree_util.tree_leaves(params))
-    return grad_step, params, ids, n_params
+    return grad_step, params, ids, n_params, effective_attn
 
 
 def main():
@@ -96,8 +100,9 @@ def main():
 
     times = {}
     meta = {}
+    effective_attn = args.attn
     for layers in (lo, hi):
-        fn, params, ids, n_params = build_grad_fn(
+        fn, params, ids, n_params, effective_attn = build_grad_fn(
             cfg, layers, on_tpu, args.head_bf16, args.attn)
         times[layers] = profiling.slope_time(fn, (params, ids))
         meta[layers] = n_params
@@ -126,6 +131,7 @@ def main():
         "step_ms_at_hi": round(times[hi] * 1e3, 2),
         "head_bf16": bool(args.head_bf16),
         "attn": args.attn,
+        "effective_attn": effective_attn,
         "unit": "ms",
     }))
 

@@ -33,6 +33,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from bluefog_tpu import ops_spmd, topology_util
+from bluefog_tpu.common.logging_util import logger
 from bluefog_tpu.core import basics
 from bluefog_tpu.core.basics import LOCAL_AXIS, MACHINES_AXIS, NODES_AXIS
 from bluefog_tpu.core.plan import CommPlan, plan_from_neighbor_lists
@@ -95,6 +96,9 @@ def device_sync(tree):
     return tree
 
 
+_POLL_BLOCK_WARNED = False
+
+
 class Handle:
     """Nonblocking-op result (the reference's integer handle +
     ``HandleManager``, ``bluefog/torch/handle_manager.h`` [U]).
@@ -125,6 +129,14 @@ class Handle:
         # make reference-style poll loops spin-claim readiness falsely
         # (round-1 verdict weak #3).  Prove readiness instead — poll may
         # block briefly, but what it returns is the truth.
+        global _POLL_BLOCK_WARNED
+        if not _POLL_BLOCK_WARNED:
+            _POLL_BLOCK_WARNED = True
+            logger.warning(
+                "Handle.poll: this platform's arrays have no async is_ready "
+                "query; poll degrades to a blocking wait, so poll-and-work "
+                "loops serialize here.  (Warned once per process.)"
+            )
         device_sync(self._value)
         return True
 
@@ -253,6 +265,40 @@ def barrier():
 WeightsArg = Union[None, Sequence[Dict[int, float]]]
 
 
+def _resolve_src_lists(
+    size: int,
+    src_arg,
+    dst_arg,
+    src_name: str,
+    dst_name: str,
+) -> list:
+    """Shared edge-set resolution for the dynamic-topology paths: per-rank
+    source lists from ``src_arg`` (each entry iterates source ranks) and/or
+    ``dst_arg`` (each entry iterates destination ranks).  Giving both
+    cross-validates that they describe the same edge set."""
+    if src_arg is None and dst_arg is None:
+        raise ValueError(f"dynamic path needs {src_name} and/or {dst_name}")
+    for nm, arg in ((src_name, src_arg), (dst_name, dst_arg)):
+        if arg is not None and len(arg) != size:
+            raise ValueError(
+                f"{nm} must be a length-{size} sequence (one entry per rank)"
+            )
+    src_lists = None
+    if src_arg is not None:
+        src_lists = [sorted(int(s) for s in src_arg[d]) for d in range(size)]
+    if dst_arg is not None:
+        inferred = topology_util.InferSourceFromDestinationRanks(
+            [sorted(int(d) for d in dst_arg[s]) for s in range(size)]
+        )
+        if src_lists is None:
+            src_lists = inferred
+        elif src_lists != [sorted(x) for x in inferred]:
+            raise ValueError(
+                f"{src_name} and {dst_name} describe different edge sets"
+            )
+    return src_lists
+
+
 def _dynamic_plan(
     size: int,
     self_weight,
@@ -266,30 +312,9 @@ def _dynamic_plan(
     dst scaling at the sender and src weighting at the receiver, SURVEY.md
     §3.2/§2.2 [U]); either side defaults to 1 when not given.
     """
-    if src_weights is None and dst_weights is None:
-        raise ValueError("dynamic path needs src_weights and/or dst_weights")
-    src_lists = [[] for _ in range(size)]
-    if src_weights is not None:
-        if len(src_weights) != size:
-            raise ValueError(
-                f"src_weights must be a length-{size} sequence (one dict per rank)"
-            )
-        for d in range(size):
-            src_lists[d] = sorted(int(s) for s in src_weights[d])
-    if dst_weights is not None:
-        if len(dst_weights) != size:
-            raise ValueError(
-                f"dst_weights must be a length-{size} sequence (one dict per rank)"
-            )
-        inferred = topology_util.InferSourceFromDestinationRanks(
-            [sorted(int(d) for d in dst_weights[s]) for s in range(size)]
-        )
-        if src_weights is None:
-            src_lists = inferred
-        elif [sorted(x) for x in src_lists] != [sorted(x) for x in inferred]:
-            raise ValueError(
-                "src_weights and dst_weights describe different edge sets"
-            )
+    src_lists = _resolve_src_lists(
+        size, src_weights, dst_weights, "src_weights", "dst_weights"
+    )
     eff = []
     for d in range(size):
         wd = {}
@@ -373,7 +398,28 @@ def neighbor_allreduce_nonblocking(
     )
 
 
-def neighbor_allgather(x, name: Optional[str] = None):
+RanksArg = Union[None, Sequence[Sequence[int]]]
+
+
+def _dynamic_gather_plan(size: int, src_ranks: RanksArg, dst_ranks: RanksArg) -> CommPlan:
+    """Per-call neighbor sets for ``neighbor_allgather`` (the reference's
+    dynamic ``src_ranks=``/``dst_ranks=`` variant in
+    ``bluefog/torch/mpi_ops.py`` [U]).  Rank-major like ``_dynamic_plan``:
+    ``src_ranks[d]`` lists the ranks d receives from; ``dst_ranks[s]`` lists
+    the ranks s sends to.  Giving both cross-validates the edge sets.
+    """
+    src_lists = _resolve_src_lists(
+        size, src_ranks, dst_ranks, "src_ranks", "dst_ranks"
+    )
+    return plan_from_neighbor_lists(size, src_lists)
+
+
+def neighbor_allgather(
+    x,
+    src_ranks: RanksArg = None,
+    dst_ranks: RanksArg = None,
+    name: Optional[str] = None,
+):
     """Concatenate in-neighbor tensors (ascending source rank) per rank:
     rank-major ``[size, n0, ...]`` -> ``[size, D*n0, ...]`` for in-degree-D
     regular topologies (reference ``bf.neighbor_allgather`` [U]).
@@ -381,10 +427,17 @@ def neighbor_allgather(x, name: Optional[str] = None):
     Irregular topologies return ``[size, maxD, n0, ...]`` zero-padded
     (static SPMD shapes cannot be ragged); valid counts are
     ``context().plan.in_degrees``.
+
+    Dynamic mode (``src_ranks``/``dst_ranks``): per-rank neighbor lists
+    define this call's edge set instead of the installed topology, mirroring
+    the dynamic-topology ``neighbor_allreduce`` path.
     """
     del name
     ctx = _ctx()
-    plan = ctx.plan
+    if src_ranks is None and dst_ranks is None:
+        plan = ctx.plan
+    else:
+        plan = _dynamic_gather_plan(ctx.size, src_ranks, dst_ranks)
     with timeline_context("neighbor_allgather"):
 
         def spmd(t):
@@ -404,8 +457,15 @@ def neighbor_allgather(x, name: Optional[str] = None):
         return f(_as_tree(x))
 
 
-def neighbor_allgather_nonblocking(x, name: Optional[str] = None) -> Handle:
-    return Handle(neighbor_allgather(x, name=name))
+def neighbor_allgather_nonblocking(
+    x,
+    src_ranks: RanksArg = None,
+    dst_ranks: RanksArg = None,
+    name: Optional[str] = None,
+) -> Handle:
+    return Handle(
+        neighbor_allgather(x, src_ranks=src_ranks, dst_ranks=dst_ranks, name=name)
+    )
 
 
 def hierarchical_neighbor_allreduce(

@@ -40,6 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from bluefog_tpu.core.basics import LOCAL_AXIS, MACHINES_AXIS
 from bluefog_tpu.core.plan import CommPlan
 from bluefog_tpu import ops_spmd
+from bluefog_tpu.training import apply_accepts_labels
 
 __all__ = [
     "make_zero_gossip_train_step",
@@ -165,6 +166,7 @@ def make_zero_gossip_train_step(
     """
     machines, local = hier_mesh.devices.shape
     lr = float(learning_rate)
+    _takes_labels = apply_accepts_labels(apply_fn)
     opt_init, opt_update = _make_update_rule(
         optimizer, lr, momentum, weight_decay)
     layout_box = {}
@@ -202,7 +204,11 @@ def make_zero_gossip_train_step(
         params = unpack_params(full, layout, compute_dtype)
 
         def local_loss(p):
-            return loss_fn(apply_fn(p, batch[0, 0]), labels[0, 0])
+            if _takes_labels:
+                out = apply_fn(p, batch[0, 0], labels=labels[0, 0])
+            else:
+                out = apply_fn(p, batch[0, 0])
+            return loss_fn(out, labels[0, 0])
 
         loss, grads = jax.value_and_grad(local_loss)(params)
         g = _pack(jax.tree_util.tree_leaves(grads), layout)
@@ -341,6 +347,7 @@ def make_fsdp_gossip_train_step(
     """
     machines, local = hier_mesh.devices.shape
     lr = float(learning_rate)
+    _takes_labels = apply_accepts_labels(apply_fn)
     opt_init, opt_update = _make_update_rule(
         optimizer, lr, momentum, weight_decay)
     W = None
@@ -397,6 +404,8 @@ def make_fsdp_gossip_train_step(
                     lambda a: a.astype(compute_dtype), master)
 
                 def one(pm, bm, lm):
+                    if _takes_labels:
+                        return loss_fn(apply_fn(pm, bm, labels=lm), lm)
                     return loss_fn(apply_fn(pm, bm), lm)
 
                 losses = jax.vmap(one)(p, batch, labels)

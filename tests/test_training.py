@@ -355,6 +355,35 @@ def test_llama_head_chunks_matches_full():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+def test_lm_loss_fns_chunked_honors_distinct_labels():
+    """r3 advisor: make_lm_loss_fns' chunked branch must not silently train
+    on inputs-as-labels when a caller passes distinct (e.g. masked) targets.
+    The chunked apply_fn now accepts labels; with labels != ids it must match
+    the full-logits CE on those labels, and differ from the ids-as-labels loss."""
+    from bluefog_tpu.models.transformer import LlamaLM
+    from bluefog_tpu.training import make_lm_loss_fns
+
+    kw = dict(vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
+              dff=64, dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 97, size=(2, 16)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, 97, size=(2, 16)), jnp.int32)
+
+    m_full = LlamaLM(**kw)
+    m_chunk = LlamaLM(**kw, head_chunks=4)
+    p = m_full.init(jax.random.PRNGKey(0), ids)["params"]
+
+    full_apply, full_loss = make_lm_loss_fns(m_full)
+    chunk_apply, chunk_loss = make_lm_loss_fns(m_chunk)
+    assert "labels" in __import__("inspect").signature(chunk_apply).parameters
+
+    ref = full_loss(full_apply({"params": p}, ids), labels)
+    got = chunk_loss(chunk_apply({"params": p}, ids, labels=labels), labels)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5)
+    ids_as_labels = chunk_loss(chunk_apply({"params": p}, ids), ids)
+    assert abs(float(got) - float(ids_as_labels)) > 1e-3
+
+
 def test_llama_head_kernel_pytree_path_unchanged():
     """The explicit _HeadKernel must keep the LM head at Dense_0/kernel
     with the nn.Dense shape/dtype (checkpoint compatibility)."""
