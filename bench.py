@@ -180,24 +180,79 @@ def main():
     )
     ar_times = [time_steps(
         step_ar, params, batch_stats, os_ar, batch, labels, warmup, iters)]
-    # UNCONDITIONAL interleaved min-of-4 per phase (round-2 verdict #3:
-    # budget-gating let machine-noise drift move the headline ±10%).
-    # Compiles are cached, so each extra pass is seconds; taking mins
-    # cancels drift, and the recorded spread says how trustworthy the
-    # round-over-round delta is.
-    for _ in range(3):
+    # ADAPTIVE interleaved passes (r3 verdict next-round #2, extending the
+    # r2 min-of-4): keep adding passes until the throughput-defining MIN is
+    # REPRODUCED — the two smallest times per phase agree within 3% — or
+    # the pass cap / wall budget runs out.  A slow tunnel session cannot
+    # make the min lie high, only fail to reproduce it, and that failure
+    # is what spread_pct then reports.
+    def min2_spread(ts):
+        # single-pass degenerate case reports 0.0 (pre-adaptive semantics;
+        # float('inf') would print non-RFC "Infinity" in the JSON line)
+        s = sorted(ts)
+        return (s[1] - s[0]) / s[0] * 100 if len(s) > 1 else 0.0
+
+    max_passes = int(os.environ.get("BENCH_MAX_PASSES", 10))
+    for _ in range(max_passes - 1):
+        enough = (len(dec_times) >= 4
+                  and min2_spread(dec_times) < 3.0
+                  and min2_spread(ar_times) < 3.0)
+        if enough or time.perf_counter() - t_start > budget_s:
+            break
         dec_times.append(time_steps(
             step_dec, params, batch_stats, os_dec, batch, labels, 1, iters))
         ar_times.append(time_steps(
             step_ar, params, batch_stats, os_ar, batch, labels, 1, iters))
     t_dec, t_ar = min(dec_times), min(ar_times)
-    # worst per-phase spread: noise in EITHER phase moves the ratio
-    spread_pct = max(
+    # spread_pct: reproducibility of the min (top-2 agreement, what the
+    # adaptive loop drives < 3); spread_all_pct: the legacy full range
+    spread_pct = max(min2_spread(dec_times), min2_spread(ar_times))
+    spread_all_pct = max(
         (max(dec_times) - t_dec) / t_dec,
         (max(ar_times) - t_ar) / t_ar,
     ) * 100
 
     imgs_per_sec_chip = per_rank_batch * spc / t_dec  # per-rank == per-chip
+
+    # Session ceiling (r3 STATUS decomposition, now emitted every run):
+    # bare XLA fwd+bwd per step — no optimizer, no gossip, no metrics —
+    # slope-timed in THIS session.  value/ceiling says how close the full
+    # step sits to what this session's tunnel+chip can do at all; a slow
+    # session is then self-describing in the JSON.
+    ceiling_img_s = ratio_to_ceiling = None
+    try:
+        import functools as _ft
+
+        @jax.jit
+        def bare_step(p, bs, x, y):
+            def loss_of(p_):
+                logits, _ = model.apply(
+                    {"params": p_, "batch_stats": bs}, x, train=True,
+                    mutable=["batch_stats"])
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y).mean()
+            return jax.value_and_grad(loss_of)(p)
+
+        p0 = jax.tree_util.tree_map(lambda a: a[0], params)
+        bs0 = jax.tree_util.tree_map(lambda a: a[0], batch_stats)
+        x0b = batch[(0, 0) if spc > 1 else (0,)]
+        y0b = labels[(0, 0) if spc > 1 else (0,)]
+        loss, grads = bare_step(p0, bs0, x0b, y0b)
+        _sync(loss)
+        rt = measure_rtt(loss)
+        bare_times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                loss, grads = bare_step(p0, bs0, x0b, y0b)
+            _sync(loss)
+            bare_times.append(
+                subtract_rtt(time.perf_counter() - t0, rt, iters, "bare"))
+        t_bare = min(bare_times)
+        ceiling_img_s = per_rank_batch / t_bare
+        ratio_to_ceiling = imgs_per_sec_chip / ceiling_img_s
+    except Exception as e:  # noqa: BLE001
+        print(f"session-ceiling phase failed: {e!r}", file=sys.stderr)
     ratio = t_ar / t_dec  # >1 means gossip step is faster than allreduce
 
     # Second BASELINE.json tracked metric: win_put gossip bandwidth —
@@ -223,6 +278,7 @@ def main():
             print(json.dumps(bw_spmd), file=sys.stderr)
         except Exception as e:  # noqa: BLE001
             print(f"spmd bandwidth phase failed: {e!r}", file=sys.stderr)
+    bw_proto = None
     if time.perf_counter() - t_start < budget_s:
         try:
             from gossip_bandwidth import measure_islands
@@ -230,6 +286,16 @@ def main():
             print(json.dumps(bw_isl), file=sys.stderr)
         except Exception as e:  # noqa: BLE001
             print(f"island bandwidth phase failed: {e!r}", file=sys.stderr)
+    if time.perf_counter() - t_start < budget_s:
+        try:
+            # protocol ceiling (single-process self-edge): how much of the
+            # 2-process shortfall is the seqlock protocol vs the 1-core
+            # scheduler (r3 verdict next-round #6)
+            from gossip_bandwidth import measure_island_protocol
+            bw_proto = measure_island_protocol(mb=16.0, iters=40)
+            print(json.dumps(bw_proto), file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"island protocol phase failed: {e!r}", file=sys.stderr)
 
     headline = {
         "metric": "ResNet-50 images/sec/chip (neighbor_allreduce exp2)"
@@ -238,8 +304,19 @@ def main():
         "value": round(imgs_per_sec_chip, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(ratio, 4),
+        # top-2-min agreement (the adaptive loop drives this < 3)
         "spread_pct": round(spread_pct, 2),
+        # legacy full min-max range across all passes
+        "spread_all_pct": round(spread_all_pct, 2),
+        "passes": len(dec_times),
     }
+    if ceiling_img_s is not None:
+        # this session's bare-XLA fwd+bwd ceiling and how close the full
+        # framework step sits to it (r3 STATUS: framework adds ~11%;
+        # ratio >= ~0.9 means a low headline is a slow session, not a
+        # code regression)
+        headline["session_ceiling_img_s"] = round(ceiling_img_s, 2)
+        headline["ratio_to_session_ceiling"] = round(ratio_to_ceiling, 4)
     if bw_spmd is not None:
         headline["win_put_gossip_bandwidth_gbs"] = bw_spmd["value"]
         headline["win_put_bandwidth_metric"] = bw_spmd["metric"]
@@ -248,6 +325,9 @@ def main():
         headline["island_win_put_gbs_per_rank"] = bw_isl["value"]
         headline["island_win_put_metric"] = bw_isl["metric"]
         headline["island_win_put_vs_raw_memcpy"] = bw_isl["vs_baseline"]
+    if bw_proto is not None:
+        headline["island_protocol_ceiling_gbs"] = bw_proto["value"]
+        headline["island_protocol_vs_raw_memcpy"] = bw_proto["vs_baseline"]
     print(json.dumps(headline))
 
 

@@ -132,7 +132,63 @@ def measure_islands(nprocs: int, mb: float, iters: int, warmup: int,
     }
 
 
+def measure_island_protocol(mb: float = 16.0, iters: int = 40) -> dict:
+    """Single-process SELF-EDGE bound on the shm-mailbox protocol cost
+    (r3 verdict next-round #6): ONE process deposits into its own mailbox
+    slot and collects back, driving the full seqlock write / read+zero
+    path with no second process and no scheduler confound.  The resulting
+    GB/s is the PROTOCOL CEILING on this host: if the 2-process number
+    sits far below it, the gap is OS time-slicing (the 1-core
+    explanation), not protocol overhead.
+
+    Accounting matches :func:`measure_islands`: value = deposited
+    payload bytes per second.  One deposit+collect round is ~3 memory
+    passes (copy-in, copy-out, collect's zeroing pass), so the ideal
+    ratio vs a single raw memcpy pass is ~1/3.
+    """
+    import os as _os
+    import time as _time
+
+    import numpy as np
+
+    from bluefog_tpu.native import shm_native
+
+    n = int(mb * 1e6 / 4)
+    payload = np.arange(n, dtype=np.float32)
+    job = f"protoprobe_{_os.getpid()}"
+    win = shm_native.make_window(job, "probe", 0, 1, 1, payload.shape,
+                                 np.float32)
+    try:
+        for _ in range(3):
+            win.write(0, 0, payload)
+            win.read(0, collect=True)
+        t0 = _time.perf_counter()
+        for _ in range(iters):
+            win.write(0, 0, payload)
+            out, _, _ = win.read(0, collect=True)
+        dt = _time.perf_counter() - t0
+        if not np.array_equal(out, payload):
+            raise RuntimeError("self-edge round-trip corrupted the payload")
+    finally:
+        win.close(unlink=True)
+        win.unlink_segments()
+    gbs = payload.nbytes * iters / dt / 1e9
+    raw = _raw_copy_gbs(mb)
+    return {
+        "metric": f"island {shm_native.island_transport()}-mailbox protocol "
+                  f"ceiling (single-process self-edge, {mb:g} MB payload)",
+        "value": round(gbs, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(gbs / raw, 4) if raw else 0.0,
+        "raw_memcpy_gbs": round(raw, 3),
+        "ideal_ratio_three_passes": 0.3333,
+    }
+
+
 def run_islands(args):
+    if args.protocol_probe:
+        print(json.dumps(measure_island_protocol(args.mb, args.iters)))
+        return
     print(json.dumps(measure_islands(
         args.islands, args.mb, args.iters, args.warmup, args.topology
     )))
@@ -148,9 +204,12 @@ def main():
     parser.add_argument("--islands", type=int, default=0, metavar="N",
                         help="measure the island shm mailbox with N processes "
                         "instead of the SPMD emulation")
+    parser.add_argument("--protocol-probe", action="store_true",
+                        help="single-process self-edge protocol ceiling "
+                        "(no second process, no scheduler confound)")
     args = parser.parse_args()
 
-    if args.islands:
+    if args.islands or args.protocol_probe:
         run_islands(args)
         return
 

@@ -94,6 +94,12 @@ from bluefog_tpu.optim import (
     broadcast_optimizer_state,
 )
 
+from bluefog_tpu.algorithms import (
+    DistributedEXTRAOptimizer,
+    DistributedGradientTrackingOptimizer,
+    DistributedPushDIGingOptimizer,
+)
+
 from bluefog_tpu.timeline import (
     timeline_start_activity,
     timeline_end_activity,

@@ -1,0 +1,341 @@
+"""Exact decentralized algorithms: gradient tracking, EXTRA, Push-DIGing.
+
+Plain gossip SGD (ATC/AWC, ``optim.py``) converges to a *neighborhood* of
+the optimum when ranks hold heterogeneous data: each rank's gradient pulls
+toward its local minimizer and the gossip only averages the iterates, so a
+bias of order ``alpha * heterogeneity`` persists.  The reference
+demonstrates the exact-method family on decentralized logistic regression
+in ``examples/pytorch_optimization.py`` [U] (push-sum / EXTRA-style
+methods, SURVEY.md §2.2 examples row); these are the TPU-native optax
+siblings (r3 verdict next-round #4).
+
+All three are SPMD transforms in the ``optim.py`` convention: they run
+inside a jitted/shard_mapped train step where the mesh axis carries the
+gossip, and communicate pytrees in ONE fused program per round (the x- and
+y-exchanges ride the same ``ppermute`` classes).
+
+- :func:`gradient_tracking_spmd` — DIGing/ATC-GT: a tracker ``y``
+  estimates the GLOBAL average gradient (``y^k = W y^{k-1} + g^k -
+  g^{k-1}``), and the iterate descends along the tracker through the same
+  mixing (``x^{k+1} = W(x^k - lr * y^k)``).  Needs a doubly-stochastic
+  mixing matrix: the built-in undirected topologies qualify (uniform
+  weights on regular graphs; Metropolis-Hastings on irregular ones are
+  symmetric).
+- :func:`extra_spmd` — EXTRA (Shi et al., SIAM J. Optim. 2015):
+  ``x^{k+1} = 2 Wt x^k - Wt x^{k-1} - lr (g^k - g^{k-1})`` with
+  ``Wt = (I + W)/2``, ``x^1 = Wt x^0 - lr g^0``.  One comm round per
+  step; same doubly-stochastic requirement.
+- :func:`push_diging_spmd` — Push-DIGing (Nedic, Olshevsky, Shi, SIAM J.
+  Optim. 2017) for DIRECTED graphs where no doubly-stochastic matrix
+  exists: column-stochastic mixing ``C`` (each sender splits its mass,
+  :func:`column_stochastic_plan`) plus a push-sum weight ``v`` that
+  de-biases the iterate (``x = u / v``).
+
+All converge to the CENTRALIZED optimum at constant step size on smooth
+strongly-convex objectives — the property the heterogeneous-shard test
+(tests/test_algorithms.py) asserts and plain ATC measurably lacks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from bluefog_tpu import ops_spmd
+from bluefog_tpu.core.basics import NODES_AXIS
+from bluefog_tpu.core.plan import CommPlan, plan_from_neighbor_lists
+
+__all__ = [
+    "gradient_tracking_spmd",
+    "extra_spmd",
+    "push_diging_spmd",
+    "column_stochastic_plan",
+    "DistributedGradientTrackingOptimizer",
+    "DistributedEXTRAOptimizer",
+    "DistributedPushDIGingOptimizer",
+]
+
+
+def column_stochastic_plan(topology) -> CommPlan:
+    """Column-stochastic mixing plan from a (directed) networkx graph:
+    sender s splits mass uniformly over its out-neighbors and itself
+    (``C[d, s] = 1 / (out_deg(s) + 1)``), so columns sum to 1 — the
+    push-sum weight convention [U, pytorch_optimization.py push-sum demo].
+    """
+    size = topology.number_of_nodes()
+    out_deg = {s: 0 for s in range(size)}
+    src_lists = [[] for _ in range(size)]
+    for s, d in topology.edges():
+        if s == d:
+            continue
+        out_deg[int(s)] += 1
+        src_lists[int(d)].append(int(s))
+    src_weights = [
+        {s: 1.0 / (out_deg[s] + 1) for s in src_lists[d]} for d in range(size)
+    ]
+    self_weights = [1.0 / (out_deg[s] + 1) for s in range(size)]
+    return plan_from_neighbor_lists(
+        size, [sorted(s) for s in src_lists],
+        src_weights=src_weights, self_weights=self_weights,
+    )
+
+
+class _GTState(NamedTuple):
+    cy: Any  # W @ y from the previous round (zeros before the first)
+    prev_g: Any
+    step: jnp.ndarray
+
+
+def gradient_tracking_spmd(
+    learning_rate: float,
+    plan: CommPlan,
+    axis_name: str = NODES_AXIS,
+) -> optax.GradientTransformation:
+    """ATC gradient tracking (DIGing family).  ``plan`` must mix with a
+    doubly-stochastic matrix (built-in undirected topologies qualify)."""
+    lr = float(learning_rate)
+
+    def comm(tree):
+        return ops_spmd.neighbor_allreduce(tree, plan, axis_name)
+
+    def init(params):
+        z = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return _GTState(cy=z, prev_g=z, step=jnp.zeros((), jnp.int32))
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("gradient tracking requires params")
+        # y^k = W y^{k-1} + g^k - g^{k-1}   (y^0 = g^0)
+        y = jax.tree_util.tree_map(
+            lambda c, g, pg: c + g - pg, state.cy, grads, state.prev_g)
+        # one fused comm round: x-descent and the tracker share the plan
+        x_new, cy = comm((
+            jax.tree_util.tree_map(lambda p, yy: p - lr * yy, params, y),
+            y,
+        ))
+        updates = jax.tree_util.tree_map(
+            lambda xn, p: (xn - p).astype(p.dtype), x_new, params)
+        return updates, _GTState(cy=cy, prev_g=grads, step=state.step + 1)
+
+    return optax.GradientTransformation(init, update)
+
+
+class _ExtraState(NamedTuple):
+    prev_wtx: Any  # Wt x^{k-1}
+    prev_g: Any
+    step: jnp.ndarray
+
+
+def extra_spmd(
+    learning_rate: float,
+    plan: CommPlan,
+    axis_name: str = NODES_AXIS,
+) -> optax.GradientTransformation:
+    """EXTRA with ``Wt = (I + W)/2``; one comm round per step."""
+    lr = float(learning_rate)
+
+    def wt(tree):
+        mixed = ops_spmd.neighbor_allreduce(tree, plan, axis_name)
+        return jax.tree_util.tree_map(lambda m, t: 0.5 * (m + t), mixed, tree)
+
+    def init(params):
+        z = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return _ExtraState(prev_wtx=z, prev_g=z,
+                           step=jnp.zeros((), jnp.int32))
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("EXTRA requires params")
+        wtx = wt(params)
+
+        def first(_):
+            # x^1 = Wt x^0 - lr g^0
+            return jax.tree_util.tree_map(
+                lambda w, g: w - lr * g, wtx, grads)
+
+        def later(_):
+            # x^{k+1} = 2 Wt x^k - Wt x^{k-1} - lr (g^k - g^{k-1})
+            return jax.tree_util.tree_map(
+                lambda w, pw, g, pg: 2.0 * w - pw - lr * (g - pg),
+                wtx, state.prev_wtx, grads, state.prev_g)
+
+        x_new = jax.lax.cond(state.step == 0, first, later, None)
+        updates = jax.tree_util.tree_map(
+            lambda xn, p: (xn - p).astype(p.dtype), x_new, params)
+        return updates, _ExtraState(
+            prev_wtx=wtx, prev_g=grads, step=state.step + 1)
+
+    return optax.GradientTransformation(init, update)
+
+
+class _PushDigingState(NamedTuple):
+    u: Any  # raw (biased) iterate; params hold x = u / v
+    v: jnp.ndarray  # push-sum weight, shape (1,)
+    cy: Any  # C @ y from the previous round
+    prev_g: Any
+    step: jnp.ndarray
+
+
+def push_diging_spmd(
+    learning_rate: float,
+    plan: CommPlan,
+    axis_name: str = NODES_AXIS,
+) -> optax.GradientTransformation:
+    """Push-DIGing over a COLUMN-stochastic plan
+    (:func:`column_stochastic_plan`): gradient tracking + push-sum
+    de-biasing for directed graphs.  Gradients are evaluated at the
+    de-biased iterate ``x = u / v``, which is what ``params`` hold."""
+    lr = float(learning_rate)
+
+    def comm(tree):
+        return ops_spmd.neighbor_allreduce(tree, plan, axis_name)
+
+    def init(params):
+        z = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return _PushDigingState(
+            u=jax.tree_util.tree_map(jnp.asarray, params),
+            v=jnp.ones((1,), jnp.float32),
+            cy=z, prev_g=z, step=jnp.zeros((), jnp.int32))
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("Push-DIGing requires params")
+        # y^k = C y^{k-1} + g^k - g^{k-1}   (y^0 = g^0)
+        y = jax.tree_util.tree_map(
+            lambda c, g, pg: c + g - pg, state.cy, grads, state.prev_g)
+        # one fused push round: u-descent, the weight v, and the tracker
+        u_new, v_new, cy = comm((
+            jax.tree_util.tree_map(lambda u, yy: u - lr * yy, state.u, y),
+            state.v,
+            y,
+        ))
+        x_new = jax.tree_util.tree_map(lambda u: u / v_new[0], u_new)
+        updates = jax.tree_util.tree_map(
+            lambda xn, p: (xn - p).astype(p.dtype), x_new, params)
+        return updates, _PushDigingState(
+            u=u_new, v=v_new, cy=cy, prev_g=grads, step=state.step + 1)
+
+    return optax.GradientTransformation(init, update)
+
+
+# --------------------------------------------------------------------------
+# Parity classes — eager, rank-major (the optim.py convention)
+# --------------------------------------------------------------------------
+
+
+class _EagerExactOptimizer:
+    """Rank-major eager wrapper over an exact SPMD transform.
+
+    Unlike ``optim._EagerDistributedOptimizer``, ``init`` also runs inside
+    ``shard_map``: Push-DIGing's push-sum weight ``v`` is per-rank state
+    with no rank-major params leaf to mirror, so the per-shard init is the
+    only correct way to lay it out."""
+
+    def __init__(self, learning_rate: float):
+        self.learning_rate = float(learning_rate)
+        self._cache = {}
+
+    def _plan(self, ctx):
+        return ctx.plan
+
+    def _make_tx(self, plan):
+        raise NotImplementedError
+
+    def _tx(self):
+        from bluefog_tpu.core import basics
+
+        ctx = basics.context()
+        plan = self._plan(ctx)
+        key = ("tx", plan)
+        if self._cache.get("tx_key") != key:
+            self._cache["tx"] = self._make_tx(plan)
+            self._cache["tx_key"] = key
+            self._cache.pop("step_fn", None)
+            self._cache.pop("init_fn", None)
+        return self._cache["tx"], ctx
+
+    def init(self, params):
+        from jax.sharding import PartitionSpec as P
+
+        tx, ctx = self._tx()
+        spec = P(NODES_AXIS)
+
+        def per_rank(p):
+            local = jax.tree_util.tree_map(lambda a: a[0], p)
+            st = tx.init(local)
+            return jax.tree_util.tree_map(
+                lambda a: a[None] if getattr(a, "ndim", 0) >= 1 else a, st)
+
+        shapes = jax.eval_shape(per_rank,
+                                jax.tree_util.tree_map(
+                                    lambda a: jax.ShapeDtypeStruct(
+                                        (1,) + a.shape[1:], a.dtype), params))
+        out_spec = jax.tree_util.tree_map(
+            lambda s: spec if s.ndim >= 1 else P(), shapes)
+        f = jax.jit(jax.shard_map(per_rank, mesh=ctx.mesh,
+                                  in_specs=P(NODES_AXIS), out_specs=out_spec))
+        return f(params)
+
+    def step(self, params, grads, state):
+        import optax as _optax
+        from jax.sharding import PartitionSpec as P
+
+        tx, ctx = self._tx()
+        spec = P(NODES_AXIS)
+        key = jax.tree_util.tree_structure(state)
+        if "step_fn" not in self._cache or self._cache["step_key"] != key:
+            state_spec = jax.tree_util.tree_map(
+                lambda a: spec
+                if getattr(a, "ndim", 0) >= 1 and a.shape[0] == ctx.size
+                else P(), state)
+
+            def whole(params, grads, state):
+                p = jax.tree_util.tree_map(lambda a: a[0], params)
+                g = jax.tree_util.tree_map(lambda a: a[0], grads)
+                st = jax.tree_util.tree_map(
+                    lambda a: a[0] if getattr(a, "ndim", 0) >= 1 else a, state)
+                updates, new_st = tx.update(g, st, p)
+                new_p = _optax.apply_updates(p, updates)
+                expand = lambda t: jax.tree_util.tree_map(
+                    lambda a: a[None] if getattr(a, "ndim", 0) >= 1 else a, t)
+                # re-expand exactly the leaves that were stripped (inside
+                # shard_map, sharded state leaves carry a leading 1)
+                return expand(new_p), jax.tree_util.tree_map(
+                    lambda new, old: new[None]
+                    if getattr(old, "ndim", 0) >= 1 else new,
+                    new_st, state)
+
+            self._cache["step_fn"] = jax.jit(
+                jax.shard_map(whole, mesh=ctx.mesh,
+                              in_specs=(spec, spec, state_spec),
+                              out_specs=(spec, state_spec)))
+            self._cache["step_key"] = key
+        return self._cache["step_fn"](params, grads, state)
+
+
+class DistributedGradientTrackingOptimizer(_EagerExactOptimizer):
+    """Gradient tracking (DIGing) on the installed (undirected) topology."""
+
+    def _make_tx(self, plan):
+        return gradient_tracking_spmd(self.learning_rate, plan)
+
+
+class DistributedEXTRAOptimizer(_EagerExactOptimizer):
+    """EXTRA on the installed (undirected) topology."""
+
+    def _make_tx(self, plan):
+        return extra_spmd(self.learning_rate, plan)
+
+
+class DistributedPushDIGingOptimizer(_EagerExactOptimizer):
+    """Push-DIGing: column-stochastic push weights derived from the
+    installed topology (which may be a directed graph)."""
+
+    def _plan(self, ctx):
+        return column_stochastic_plan(ctx.topology)
+
+    def _make_tx(self, plan):
+        return push_diging_spmd(self.learning_rate, plan)

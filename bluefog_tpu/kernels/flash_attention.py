@@ -49,6 +49,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Callable, Optional, Tuple
 
 import jax
@@ -64,6 +65,18 @@ __all__ = ["flash_attention", "flash_attention_with_lse", "make_flash_attention_
 _NEG_INF = -1e30  # finite mask sentinel (real scores can never reach it)
 _MASK_THRESH = -0.5e30  # "was this entry masked" test after sentinel fill
 _LANES = 128
+# Lane width for the per-row scalars (lse, corr).  The backward re-reads
+# one scalar tile per (q-block, k-block) pair, so 128-lane replication is
+# ~1.8 GB of HBM traffic per 134M layer (r3 advisor finding) and 8 lanes
+# would be ~0.1 GB — but the END-TO-END A/B (2 interleaved passes of
+# benchmarks/llama.py per variant, r4) measured 8 lanes 3-4% SLOWER:
+# Mosaic's narrow (512x8 f32) input DMA costs more than the fat
+# replicated reads, which the fwd+bwd overlap evidently hides.  128
+# stays the default; the knob records the experiment and serves future
+# hardware.  (Microbenchmark A/Bs through this tunnel are useless —
+# spreads >100% — hence the end-to-end protocol.)
+_SCALAR_LANES = int(os.environ.get("BLUEFOG_FLASH_SCALAR_LANES", "128"))
+_ALIGNED_ENABLED = os.environ.get("BLUEFOG_FLASH_ALIGNED", "1") != "0"
 _MAX_UNROLL = 64  # triangular fast paths unroll at most this many k blocks
 
 
@@ -131,11 +144,41 @@ def _out_struct(shape, dtype, operands):
         return jax.ShapeDtypeStruct(shape, dtype)
 
 
+def _scale_folds_exactly(scale: float) -> bool:
+    """True when ``scale`` is a power of two — folding it into a bf16
+    operand is then an exact exponent shift (head dim a power of 4, e.g.
+    D=64 -> 1/8).  Otherwise folding would round q*scale to bf16 and the
+    scale stays on the f32 scores."""
+    m, _ = math.frexp(scale)
+    return scale > 0 and m == 0.5
+
+
+def _aligned_mask(s, block_q, block_k, delta):
+    """Cheap diagonal-tile causal mask for the aligned (static-offset) fast
+    path: one broadcast compare of a [bq,1] row iota against a [1,bk]
+    column iota, instead of two full-tile 2D iotas + add + compare.
+    Visible iff col + delta <= row (delta 0 = aligned; 1 = the striped
+    ring's strict-lower-triangle hops)."""
+    row = lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    col = lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+    return jnp.where(col + delta <= row, s, _NEG_INF)
+
+
 def _fwd_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc, m_ref, l_ref,
                 *, scale: float, block_q: int, block_k: int, causal: bool,
-                num_k: int):
-    """One (bh, iq, jk) program: fold k-block jk into the online softmax."""
+                num_k: int, aligned_delta):
+    """One (bh, iq, jk) program: fold k-block jk into the online softmax.
+
+    ``aligned_delta`` (static int or None) enables the aligned fast path:
+    offsets are statically equal (+delta), so interior tiles (jk < iq) run
+    with NO mask VPU work at all, diagonal tiles get the cheap broadcast
+    mask, and the sentinel-row fixup exists only when a fully-masked row is
+    actually possible (delta > 0).  The earlier uniform-kernel note ("a
+    lax.cond skipping the mask measured slower") held for a runtime-offset
+    cond inside one body; the static split compiles two bodies and measured
+    faster (see module docstring history).
+    """
     iq = pl.program_id(1)
     jk = pl.program_id(2)
 
@@ -145,33 +188,48 @@ def _fwd_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc[...] = jnp.zeros_like(acc)
 
-    def _body():
+    fold = _scale_folds_exactly(scale)
+
+    def _body(masked):
         # operands stay in their storage dtype (bf16 on TPU — full-rate MXU
         # passes); fp32 happens only in the accumulator via
         # preferred_element_type.  Casting to fp32 first would force the
         # MXU's slow fp32 path and make the kernel slower than dense XLA.
+        # When scale is a power of two (head dims that are powers of 4 —
+        # exact exponent shift, no rounding) it folds into the
+        # [block_q, D] q operand: a D-wide VPU pass replaces a
+        # block_k-wide one on the scores.
         q = q_ref[0]  # [block_q, D]
+        if fold:
+            q = q * jnp.asarray(scale, q_ref.dtype)
         k = k_ref[0]  # [block_k, D]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [block_q, block_k] fp32
-        if causal:
-            # unconditional element mask: a lax.cond skipping it for
-            # fully-visible blocks measured *slower* (Mosaic control-flow
-            # overhead exceeds the iota/where VPU cost)
-            qpos = qs_ref[0, 0] + iq * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            kpos = ks_ref[0, 0] + jk * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(kpos <= qpos, s, _NEG_INF)
+        )  # [block_q, block_k] fp32
+        if not fold:
+            s = s * scale
+        sentinel_rows = False
+        if masked:
+            if aligned_delta is None:
+                qpos = qs_ref[0, 0] + iq * block_q + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0
+                )
+                kpos = ks_ref[0, 0] + jk * block_k + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1
+                )
+                s = jnp.where(kpos <= qpos, s, _NEG_INF)
+                sentinel_rows = True  # dynamic offsets: fully-masked rows possible
+            else:
+                s = _aligned_mask(s, block_q, block_k, aligned_delta)
+                # delta == 0: every row of a diagonal tile sees >= 1 key,
+                # masked entries underflow to 0 through exp(s - m_new)
+                sentinel_rows = aligned_delta > 0
         m_prev = m_ref[:, :1]  # [block_q, 1] (replicated columns)
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)  # [block_q, 1]
         p = jnp.exp(s - m_new)  # [block_q, block_k]
-        if causal:
+        if sentinel_rows:
             # fully-masked rows have m_new == sentinel and would otherwise
             # contribute exp(0) == 1 per entry
             p = jnp.where(s > _MASK_THRESH, p, 0.0)
@@ -183,25 +241,44 @@ def _fwd_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    if causal:
+    if causal and aligned_delta is not None:
+        pl.when(jk < iq)(lambda: _body(False))
+        pl.when(jk == iq)(lambda: _body(True))
+    elif causal:
         # predicate away k blocks entirely above the diagonal (runtime skip:
         # the offsets are dynamic, so this can't prune at compile time)
         first_k = ks_ref[0, 0] + jk * block_k
         last_q = qs_ref[0, 0] + (iq + 1) * block_q - 1
-        pl.when(first_k <= last_q)(_body)
+        pl.when(first_k <= last_q)(lambda: _body(True))
     else:
-        _body()
+        _body(False)
 
     @pl.when(jk == num_k - 1)
     def _finish():
         l = l_ref[:, :1]
         o_ref[0] = (acc[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-        lse = m_ref[...] + jnp.log(jnp.maximum(l_ref[...], 1e-30))
+        lse = m_ref[:, :_SCALAR_LANES] + jnp.log(
+            jnp.maximum(l_ref[:, :_SCALAR_LANES], 1e-30))
         lse_ref[0] = lse.astype(jnp.float32)
 
 
+def _aligned_or_none(tri_delta, causal, tq, tk, block_q, block_k):
+    """The Pallas aligned fast path needs: causal, statically-equal offsets
+    (+delta <= 1), square shapes, and equal block sizes (tile (i, j) sits
+    exactly on the diagonal iff i == j).  delta <= 1 is load-bearing: the
+    path leaves interior tiles (jk < iq) UNMASKED, which is exactly valid
+    for delta 0 (aligned) and 1 (the striped ring's strict lower
+    triangle); at delta >= 2 the last key of tile iq-1 would be a future
+    position for the first row of q block iq.  Larger static deltas fall
+    back to the general masked path."""
+    if (_ALIGNED_ENABLED and causal and tri_delta is not None
+            and tri_delta <= 1 and tq == tk and block_q == block_k):
+        return tri_delta
+    return None
+
+
 def _flash_fwd(q, k, v, q_start, k_start, *, scale, causal, block_q, block_k,
-               interpret):
+               interpret, tri_delta=None):
     """q,k,v: [BH, T, D]; q_start/k_start: int32 scalars (global offsets).
 
     Returns (o [BH, Tq, D], lse [BH, Tq]).
@@ -222,6 +299,8 @@ def _flash_fwd(q, k, v, q_start, k_start, *, scale, causal, block_q, block_k,
         block_k=block_k,
         causal=causal,
         num_k=num_k,
+        aligned_delta=_aligned_or_none(tri_delta, causal, tq, tk,
+                                       block_q, block_k),
     )
     smem = pl.BlockSpec((1, 1), lambda b, i, j: (0, 0),
                         memory_space=pltpu.SMEM)
@@ -237,11 +316,11 @@ def _flash_fwd(q, k, v, q_start, k_start, *, scale, causal, block_q, block_k,
         ],
         out_specs=[
             _block_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            _block_spec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+            _block_spec((1, block_q, _SCALAR_LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             _out_struct((bh, tq, d), q.dtype, (q, k, v)),
-            _out_struct((bh, tq, _LANES), jnp.float32, (q, k, v)),
+            _out_struct((bh, tq, _SCALAR_LANES), jnp.float32, (q, k, v)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -331,13 +410,14 @@ def _blockwise_fwd_xla(q, k, v, q_start, k_start, *, scale, causal, block_k,
 def _bwd_dkv_kernel(qs_ref, ks_ref, q_ref, g_ref, lse_ref, corr_ref,
                     k_ref, v_ref, dk_ref, dv_ref, dk_acc, dv_acc,
                     *, scale: float, block_q: int, block_k: int,
-                    causal: bool, num_q: int):
+                    causal: bool, num_q: int, aligned_delta):
     """One (bh, jk, iq) program: fold q-block iq into dK/dV of k-block jk.
 
     Same recompute-from-lse trick as the XLA backward, but the
     [block_q, block_k] probability/score tiles live and die in VMEM —
     the XLA path materializes them per k-block in HBM, which is why the
     backward measured memory-bound (docs/STATUS.md round-3 decomposition).
+    ``aligned_delta``: see :func:`_fwd_kernel`.
     """
     jk = pl.program_id(1)
     iq = pl.program_id(2)
@@ -347,25 +427,37 @@ def _bwd_dkv_kernel(qs_ref, ks_ref, q_ref, g_ref, lse_ref, corr_ref,
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    def _body():
+    fold = _scale_folds_exactly(scale)
+
+    def _body(masked):
         q = q_ref[0]  # [block_q, D]
         g = g_ref[0]  # [block_q, D]
         k = k_ref[0]  # [block_k, D]
         v = v_ref[0]  # [block_k, D]
         lse = lse_ref[0][:, :1]  # [block_q, 1] (lane-replicated input)
         corr = corr_ref[0][:, :1]
+        qk = q * jnp.asarray(scale, q_ref.dtype) if fold else q
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+            qk, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # [block_q, block_k] fp32
-        if causal:
-            qpos = qs_ref[0, 0] + iq * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = ks_ref[0, 0] + jk * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(kpos <= qpos, s, _NEG_INF)
-        # masked entries (and whole sentinel-lse rows) exp to exactly 0
-        p = jnp.exp(jnp.where(s > _MASK_THRESH, s - lse, _NEG_INF))
+        )  # [block_q, block_k] fp32
+        if not fold:
+            s = s * scale
+        if masked:
+            if aligned_delta is None:
+                qpos = qs_ref[0, 0] + iq * block_q + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                kpos = ks_ref[0, 0] + jk * block_k + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(kpos <= qpos, s, _NEG_INF)
+            else:
+                s = _aligned_mask(s, block_q, block_k, aligned_delta)
+            # masked entries (and whole sentinel-lse rows) exp to exactly 0
+            p = jnp.exp(jnp.where(s > _MASK_THRESH, s - lse, _NEG_INF))
+        else:
+            # interior tile: nothing is masked and (aligned path) no
+            # sentinel-lse row can appear here — plain recompute
+            p = jnp.exp(s - lse)
         dv_acc[...] += jax.lax.dot_general(
             p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -374,31 +466,38 @@ def _bwd_dkv_kernel(qs_ref, ks_ref, q_ref, g_ref, lse_ref, corr_ref,
             g, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = (p * (dp + corr) * scale).astype(q.dtype)
+        # ds stays UNSCALED per tile; scale multiplies the f32 accumulator
+        # once at _finish (a [block_k, D] pass instead of a
+        # [block_q, block_k] pass per tile — exact, any scale)
+        ds = (p * (dp + corr)).astype(q.dtype)
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
-    if causal:
+    if causal and aligned_delta is not None:
+        pl.when(iq > jk)(lambda: _body(False))
+        pl.when(iq == jk)(lambda: _body(True))
+    elif causal:
         # skip q blocks entirely above the diagonal (they reach no k row)
         last_q = qs_ref[0, 0] + (iq + 1) * block_q - 1
         first_k = ks_ref[0, 0] + jk * block_k
-        pl.when(last_q >= first_k)(_body)
+        pl.when(last_q >= first_k)(lambda: _body(True))
     else:
-        _body()
+        _body(False)
 
     @pl.when(iq == num_q - 1)
     def _finish():
-        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dk_ref[0] = (dk_acc[...] * scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _bwd_dq_kernel(qs_ref, ks_ref, q_ref, g_ref, lse_ref, corr_ref,
                    k_ref, v_ref, dq_ref, dq_acc,
                    *, scale: float, block_q: int, block_k: int,
-                   causal: bool, num_k: int):
-    """One (bh, iq, jk) program: fold k-block jk into dQ of q-block iq."""
+                   causal: bool, num_k: int, aligned_delta):
+    """One (bh, iq, jk) program: fold k-block jk into dQ of q-block iq.
+    ``aligned_delta``: see :func:`_fwd_kernel`."""
     iq = pl.program_id(1)
     jk = pl.program_id(2)
 
@@ -406,48 +505,63 @@ def _bwd_dq_kernel(qs_ref, ks_ref, q_ref, g_ref, lse_ref, corr_ref,
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    def _body():
+    fold = _scale_folds_exactly(scale)
+
+    def _body(masked):
         q = q_ref[0]
         g = g_ref[0]
         k = k_ref[0]
         v = v_ref[0]
         lse = lse_ref[0][:, :1]
         corr = corr_ref[0][:, :1]
+        qk = q * jnp.asarray(scale, q_ref.dtype) if fold else q
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+            qk, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale
-        if causal:
-            qpos = qs_ref[0, 0] + iq * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = ks_ref[0, 0] + jk * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(kpos <= qpos, s, _NEG_INF)
-        p = jnp.exp(jnp.where(s > _MASK_THRESH, s - lse, _NEG_INF))
+        )
+        if not fold:
+            s = s * scale
+        if masked:
+            if aligned_delta is None:
+                qpos = qs_ref[0, 0] + iq * block_q + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                kpos = ks_ref[0, 0] + jk * block_k + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(kpos <= qpos, s, _NEG_INF)
+            else:
+                s = _aligned_mask(s, block_q, block_k, aligned_delta)
+            p = jnp.exp(jnp.where(s > _MASK_THRESH, s - lse, _NEG_INF))
+        else:
+            p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             g, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = (p * (dp + corr) * scale).astype(q.dtype)
+        # unscaled ds; scale applied once to the accumulator at _finish
+        ds = (p * (dp + corr)).astype(q.dtype)
         dq_acc[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
-    if causal:
+    if causal and aligned_delta is not None:
+        pl.when(jk < iq)(lambda: _body(False))
+        pl.when(jk == iq)(lambda: _body(True))
+    elif causal:
         first_k = ks_ref[0, 0] + jk * block_k
         last_q = qs_ref[0, 0] + (iq + 1) * block_q - 1
-        pl.when(first_k <= last_q)(_body)
+        pl.when(first_k <= last_q)(lambda: _body(True))
     else:
-        _body()
+        _body(False)
 
     @pl.when(jk == num_k - 1)
     def _finish():
-        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+        dq_ref[0] = (dq_acc[...] * scale).astype(dq_ref.dtype)
 
 
 def _flash_bwd_pallas(q, k, v, lse, corr, q_start, k_start, g,
-                      *, scale, causal, block_q, block_k, interpret):
+                      *, scale, causal, block_q, block_k, interpret,
+                      tri_delta=None):
     """dQ/dK/dV via two Pallas kernels; all [BH, T, D].
 
     ``corr`` is ``g_lse − rowsum(o·g)`` per q row (f32, [BH, Tq]) — the
@@ -459,13 +573,15 @@ def _flash_bwd_pallas(q, k, v, lse, corr, q_start, k_start, g,
     block_q = _fit_block(tq, block_q)
     block_k = _fit_block(tk, block_k)
     num_q, num_k = tq // block_q, tk // block_k
+    aligned = _aligned_or_none(tri_delta, causal, tq, tk, block_q, block_k)
 
     qs = jnp.asarray(q_start, jnp.int32).reshape(1, 1)
     ks = jnp.asarray(k_start, jnp.int32).reshape(1, 1)
-    # per-row scalars ride lane-replicated (the Mosaic-friendly layout,
-    # same convention as the forward kernel's lse output)
-    lse_b = jnp.broadcast_to(lse[..., None], (bh, tq, _LANES))
-    corr_b = jnp.broadcast_to(corr[..., None], (bh, tq, _LANES))
+    # per-row scalars ride at _SCALAR_LANES lanes: each (q-block, k-block)
+    # grid step re-reads its scalar tile, so lane count multiplies HBM
+    # traffic (128 lanes measured ~1.8 GB per 134M layer; 8 lanes ~0.1 GB)
+    lse_b = jnp.broadcast_to(lse[..., None], (bh, tq, _SCALAR_LANES))
+    corr_b = jnp.broadcast_to(corr[..., None], (bh, tq, _SCALAR_LANES))
 
     smem = pl.BlockSpec((1, 1), lambda *_: (0, 0), memory_space=pltpu.SMEM)
 
@@ -473,9 +589,9 @@ def _flash_bwd_pallas(q, k, v, lse, corr, q_start, k_start, g,
         return [
             _block_spec((1, block_q, d), lambda b, x, y: (b, index(x, y), 0)),
             _block_spec((1, block_q, d), lambda b, x, y: (b, index(x, y), 0)),
-            _block_spec((1, block_q, _LANES),
+            _block_spec((1, block_q, _SCALAR_LANES),
                         lambda b, x, y: (b, index(x, y), 0)),
-            _block_spec((1, block_q, _LANES),
+            _block_spec((1, block_q, _SCALAR_LANES),
                         lambda b, x, y: (b, index(x, y), 0)),
         ]
 
@@ -488,7 +604,7 @@ def _flash_bwd_pallas(q, k, v, lse, corr, q_start, k_start, g,
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, block_q=block_q, block_k=block_k,
-            causal=causal, num_q=num_q),
+            causal=causal, num_q=num_q, aligned_delta=aligned),
         grid=(bh, num_k, num_q),
         in_specs=[smem, smem,
                   *rowspec(lambda j, i: i), *kvspec(lambda j, i: j)],
@@ -510,7 +626,7 @@ def _flash_bwd_pallas(q, k, v, lse, corr, q_start, k_start, g,
     dq, = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, block_q=block_q, block_k=block_k,
-            causal=causal, num_k=num_k),
+            causal=causal, num_k=num_k, aligned_delta=aligned),
         grid=(bh, num_q, num_k),
         in_specs=[smem, smem,
                   *rowspec(lambda i, j: i), *kvspec(lambda i, j: j)],
@@ -628,7 +744,7 @@ def _fwd_dispatch(q, k, v, q_start, k_start, *, scale, causal, block_q,
     return _flash_fwd(
         q, k, v, q_start, k_start,
         scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-        interpret=interpret,
+        interpret=interpret, tri_delta=tri_delta,
     )
 
 
@@ -677,7 +793,7 @@ def _flash_core_bwd(scale, causal, block_q, block_k, interpret, tri_delta,
             q, k, v, lse, corr,
             q_start.astype(jnp.int32), k_start.astype(jnp.int32), g,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-            interpret=interpret,
+            interpret=interpret, tri_delta=tri_delta,
         )
     return dq, dk, dv, jnp.zeros_like(q_start), jnp.zeros_like(k_start)
 

@@ -40,7 +40,12 @@ def main():
     parser.add_argument("--samples-per-rank", type=int, default=64)
     parser.add_argument("--lr", type=float, default=0.5)
     parser.add_argument(
-        "--mode", default="atc", choices=["atc", "awc", "allreduce"]
+        "--mode",
+        default="atc",
+        choices=["atc", "awc", "allreduce", "gt", "extra", "pushdiging"],
+        help="atc/awc: gossip SGD (converges to a neighborhood under "
+        "heterogeneous shards); gt/extra/pushdiging: exact methods that "
+        "reach the centralized optimum (bluefog_tpu.algorithms)",
     )
     args = parser.parse_args()
 
@@ -65,8 +70,16 @@ def main():
         opt = bf.DistributedAdaptThenCombineOptimizer(optax.sgd(sched))
     elif args.mode == "awc":
         opt = bf.DistributedAdaptWithCombineOptimizer(optax.sgd(sched))
-    else:
+    elif args.mode == "allreduce":
         opt = bf.DistributedGradientAllreduceOptimizer(optax.sgd(sched))
+    elif args.mode == "gt":
+        # exact methods run at a CONSTANT step (their point: no decay
+        # schedule needed to kill the heterogeneity bias)
+        opt = bf.DistributedGradientTrackingOptimizer(args.lr)
+    elif args.mode == "extra":
+        opt = bf.DistributedEXTRAOptimizer(args.lr)
+    else:
+        opt = bf.DistributedPushDIGingOptimizer(args.lr)
 
     params = {"w": jnp.zeros((n, args.dim))}
     state = opt.init(params)

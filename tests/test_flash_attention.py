@@ -32,6 +32,36 @@ def test_flash_matches_dense(causal, t, block):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.parametrize("delta", [0, 1, 4])
+def test_pallas_static_key_ahead_delta_matches_dense(delta):
+    """Static equal-ish offsets with key-ahead delta: 0 and 1 take the
+    Pallas ALIGNED fast path (interior tiles unmasked); delta >= 2 MUST
+    fall back to the general masked path — the aligned path's unmasked
+    interior tiles would attend to future keys there (r4 review finding)."""
+    from bluefog_tpu.kernels.flash_attention import (
+        _aligned_or_none,
+        flash_attention_with_lse,
+    )
+
+    assert _aligned_or_none(delta, True, 32, 32, 16, 16) == (
+        delta if delta <= 1 else None)
+
+    t = 32
+    q, k, v = _rand_qkv(jax.random.PRNGKey(7), 2, t, 3, 16)
+    out, _ = flash_attention_with_lse(
+        q, k, v, q_start=0, k_start=delta, causal=True,
+        block_q=16, block_k=16, impl="pallas", interpret=True,
+    )
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(16)
+    qpos = jnp.arange(t)
+    kpos = delta + jnp.arange(t)
+    scores = jnp.where(kpos[None, :] <= qpos[:, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)  # fully-masked rows
+    ref = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
 def test_flash_uneven_q_k_blocks():
     q, k, v = _rand_qkv(jax.random.PRNGKey(1), 1, 64, 2, 8)
     out = flash_attention(

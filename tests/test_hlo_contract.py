@@ -1,0 +1,282 @@
+"""HLO perf-contract tests (r3 verdict next-round #3).
+
+Compile each communication path at n=8 on the CPU mesh and assert its
+COLLECTIVE INVENTORY from the post-partitioner HLO — the strongest
+multi-chip perf evidence obtainable without multi-chip hardware, and a
+tripwire against GSPMD regressions on jax upgrades (an accidental
+all-gather sneaking into the neighbor path would silently turn O(deg)
+gossip into O(n) traffic; the reference's equivalent property is that
+``MPI_Neighbor_allgather`` runs exactly along the graph communicator's
+edges, ``bluefog/common/mpi_controller.cc`` [U]).
+
+Method follows ``benchmarks/scan_gather_probe.py``: ``jit(...).lower(...)
+.compile().as_text()`` and count collective opcodes.  ``-start`` forms
+count once; ``-done`` forms are ignored.
+"""
+
+import functools
+import re
+from collections import Counter
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import bluefog_tpu as bf
+from bluefog_tpu import ops_spmd, topology_util as tu
+from bluefog_tpu.core import basics
+from bluefog_tpu.core.basics import LOCAL_AXIS, MACHINES_AXIS, NODES_AXIS
+
+SIZE = 8
+
+COLLECTIVES = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+# opcode sits after `=` and the (possibly tuple) result type
+_OP_RE = re.compile(r"=\s*(?:\([^)]*\)|[^\s(]+)\s+([a-z][a-z0-9\-]*)\(")
+
+
+@pytest.fixture(autouse=True)
+def fresh_context(devices):
+    bf.init(local_size=2)
+    yield
+    bf.shutdown()
+
+
+def collective_counts(compiled_text: str) -> Counter:
+    counts = Counter()
+    for m in _OP_RE.finditer(compiled_text):
+        op = m.group(1)
+        if op.endswith("-done"):
+            continue
+        if op.endswith("-start"):
+            op = op[: -len("-start")]
+        if op in COLLECTIVES:
+            counts[op] += 1
+    return counts
+
+
+def _compiled_text(fn, *args):
+    return jax.jit(fn).lower(*args).compile().as_text()
+
+
+def _rank_major(spmd_fn, mesh):
+    return jax.shard_map(spmd_fn, mesh=mesh, in_specs=P(NODES_AXIS),
+                         out_specs=P(NODES_AXIS))
+
+
+def _assert_only(counts: Counter, expected: dict):
+    """Exact inventory: every listed opcode at its exact count, every
+    unlisted collective at zero."""
+    for op in COLLECTIVES:
+        assert counts.get(op, 0) == expected.get(op, 0), (
+            f"collective inventory drifted: expected {expected}, got "
+            f"{dict(counts)}"
+        )
+
+
+def test_allreduce_is_one_allreduce():
+    ctx = basics.context()
+    x = jnp.zeros((SIZE, 4))
+    fn = _rank_major(
+        functools.partial(ops_spmd.allreduce, axis_name=NODES_AXIS,
+                          average=True), ctx.mesh)
+    counts = collective_counts(_compiled_text(fn, x))
+    _assert_only(counts, {"all-reduce": 1})
+
+
+def test_neighbor_allreduce_exp2_is_three_permutes():
+    """exp2@8 has shift classes {1, 2, 4}: exactly log2(8) = 3
+    collective-permutes, zero all-gathers — O(deg) gossip, the whole point
+    of the shift-class plan compiler (core/plan.py)."""
+    bf.set_topology(tu.ExponentialTwoGraph(SIZE))
+    ctx = basics.context()
+    x = jnp.zeros((SIZE, 4))
+    fn = _rank_major(
+        functools.partial(ops_spmd.neighbor_allreduce, plan=ctx.plan,
+                          axis_name=NODES_AXIS), ctx.mesh)
+    counts = collective_counts(_compiled_text(fn, x))
+    _assert_only(counts, {"collective-permute": 3})
+
+
+def test_neighbor_allreduce_ring_is_two_permutes():
+    bf.set_topology(tu.RingGraph(SIZE))
+    ctx = basics.context()
+    x = jnp.zeros((SIZE, 4))
+    fn = _rank_major(
+        functools.partial(ops_spmd.neighbor_allreduce, plan=ctx.plan,
+                          axis_name=NODES_AXIS), ctx.mesh)
+    counts = collective_counts(_compiled_text(fn, x))
+    _assert_only(counts, {"collective-permute": 2})
+
+
+def test_dynamic_one_peer_is_one_permute():
+    """The one-peer exp2 rotation moves ONE hop per step — its compiled
+    program must hold exactly one collective-permute."""
+    from bluefog_tpu.ops import _dynamic_plan
+
+    gen = tu.GetDynamicOnePeerSendRecvRanks(SIZE, 0)
+    to_ranks, from_ranks = next(gen)
+    # rank-major dynamic args: every rank sends to (rank + 1) % SIZE this
+    # step (the rotation is uniform across ranks by construction)
+    dst = [{(r + 1) % SIZE: 1.0} for r in range(SIZE)]
+    plan = _dynamic_plan(SIZE, None, None, dst)
+    ctx = basics.context()
+    x = jnp.zeros((SIZE, 4))
+    fn = _rank_major(
+        functools.partial(ops_spmd.neighbor_allreduce, plan=plan,
+                          axis_name=NODES_AXIS), ctx.mesh)
+    counts = collective_counts(_compiled_text(fn, x))
+    _assert_only(counts, {"collective-permute": 1})
+
+
+def test_hierarchical_is_local_reduce_plus_machine_permutes():
+    """hierarchical = ONE local all-reduce (the pmean) + machine-axis
+    permutes only (ring@4 machines -> 2 shift classes); the implicit local
+    broadcast must be free (pmean already leaves local ranks identical)."""
+    bf.set_machine_topology(tu.RingGraph(4))
+    ctx = basics.context()
+    mplan = ctx.machine_plan
+    x = jnp.zeros((SIZE, 4))
+
+    def spmd(t):
+        return ops_spmd.hierarchical_neighbor_allreduce(
+            t, machine_plan=mplan, machines_axis=MACHINES_AXIS,
+            local_axis=LOCAL_AXIS)
+
+    fn = jax.shard_map(spmd, mesh=ctx.hier_mesh,
+                       in_specs=P((MACHINES_AXIS, LOCAL_AXIS)),
+                       out_specs=P((MACHINES_AXIS, LOCAL_AXIS)))
+    counts = collective_counts(_compiled_text(fn, x))
+    _assert_only(counts, {"all-reduce": 1, "collective-permute": 2})
+
+
+def test_window_exchange_one_permute_per_shift_class():
+    """The fused window exchange (win_put + mailbox update in one program)
+    must move data with exactly one permute per shift class — the ppermute
+    lowering of MPI_Put (windows.py module docstring)."""
+    from bluefog_tpu.windows import _build_exchange
+
+    bf.set_topology(tu.ExponentialTwoGraph(SIZE))
+    ctx = basics.context()
+    plan = ctx.plan
+    nclasses = len(plan.classes)
+    maxd = plan.max_in_degree
+    x = jnp.zeros((SIZE, 4), jnp.float32)
+    mail = jnp.zeros((SIZE, maxd, 4), jnp.float32)
+    ver = jnp.zeros((SIZE, maxd), jnp.int32)
+    p_self = jnp.ones((SIZE,), jnp.float32)
+    p_mail = jnp.ones((SIZE, maxd), jnp.float32)
+    scales = jnp.ones((nclasses, SIZE), jnp.float32)
+    active = jnp.ones((nclasses, SIZE), jnp.float32)
+
+    f = _build_exchange(plan, accumulate=False, with_p=False, donate=False)
+    text = f.lower(x, mail, ver, p_self, p_mail, scales, active).compile().as_text()
+    counts = collective_counts(text)
+    _assert_only(counts, {"collective-permute": nclasses})
+
+
+def test_zero_packed_one_gather_one_scatter():
+    """ZeRO-1 packed step: params assemble through exactly ONE all-gather
+    and gradients shard through exactly ONE reduce-scatter; any extra
+    gather would break the memory story the 8B table depends on.  The
+    scalar loss mean is the only all-reduce allowed."""
+    from bluefog_tpu.parallel.zero import make_zero_gossip_train_step
+
+    ctx = basics.context()
+    # single machine x 8 local: pure ZeRO, no gossip permutes
+    bf.init(local_size=8)
+    ctx = basics.context()
+    mesh = ctx.hier_mesh
+
+    def apply_fn(p, x):
+        return jnp.tanh(x @ p["w"]) @ p["v"]
+
+    def loss_fn(out, y):
+        return jnp.mean((out - y) ** 2)
+
+    init_fn, step_fn, _ = make_zero_gossip_train_step(
+        apply_fn, loss_fn, mesh, None, learning_rate=0.1)
+    params = {"w": jnp.zeros((16, 32)), "v": jnp.zeros((32, 8))}
+    state = init_fn(params)
+    data_sh = NamedSharding(mesh, P(MACHINES_AXIS, LOCAL_AXIS))
+    batch = jax.device_put(jnp.zeros((1, 8, 4, 16)), data_sh)
+    labels = jax.device_put(jnp.zeros((1, 8, 4, 8)), data_sh)
+    # step_fn is a plain wrapper around an inner jit; jitting the wrapper
+    # inlines the inner program so its collectives appear in one HLO
+    text = jax.jit(step_fn).lower(state, batch, labels).compile().as_text()
+    counts = collective_counts(text)
+    assert counts.get("all-gather", 0) == 1, counts
+    assert counts.get("reduce-scatter", 0) == 1, counts
+    assert counts.get("all-to-all", 0) == 0, counts
+    assert counts.get("collective-permute", 0) == 0, counts
+    # scalar loss mean (and nothing bigger) may all-reduce
+    assert counts.get("all-reduce", 0) <= 2, counts
+
+
+def test_scan_stacked_leaves_gather_whole_pinned():
+    """Pin scan_gather_probe's finding (its docstring demands a re-run
+    "before relying on it" after upgrades): under FSDP+GSPMD, scan-stacked
+    leaves all-gather with the FULL layer axis.  zero_8b ships unrolled
+    leaves because of this.  If this test ever fails (XLA started slicing
+    per layer), that choice must be re-evaluated — failure here is a
+    design-input change, not a bug."""
+    from bluefog_tpu.models.transformer import LlamaLM
+    from bluefog_tpu.parallel.zero import (
+        fsdp_state_struct,
+        make_fsdp_gossip_train_step,
+    )
+
+    bf.init(local_size=4)
+    ctx = basics.context()
+    bf.set_machine_topology(tu.RingGraph(2))
+    layers = 6
+    lm = LlamaLM(vocab_size=97, hidden_size=32, num_layers=layers,
+                 num_heads=4, dff=64, remat=True, scan_layers=True,
+                 dtype=jnp.float32)
+    ids0 = jnp.ones((2, 16), jnp.int32)
+    p_shapes = jax.eval_shape(lm.init, jax.random.PRNGKey(0), ids0)["params"]
+
+    def apply_fn(p, ids):
+        return lm.apply({"params": p}, ids)
+
+    def loss_fn(logits, labels):
+        lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+        return -jnp.mean(jnp.take_along_axis(lp, labels[:, 1:, None], -1))
+
+    _, step_fn, _ = make_fsdp_gossip_train_step(
+        apply_fn, loss_fn, ctx.hier_mesh, ctx.machine_plan,
+        learning_rate=0.1)
+    master = jax.tree_util.tree_map(
+        lambda l: fsdp_state_struct(l, ctx.hier_mesh), p_shapes)
+    mu = jax.tree_util.tree_map(
+        lambda l: fsdp_state_struct(l, ctx.hier_mesh), p_shapes)
+    data_sh = NamedSharding(ctx.hier_mesh, P(MACHINES_AXIS, LOCAL_AXIS))
+    ids_s = jax.ShapeDtypeStruct((2, 4 * 2, 16), jnp.int32, sharding=data_sh)
+    text = step_fn.lower(
+        {"master": master, "opt": (mu,)}, ids_s, ids_s).compile().as_text()
+
+    # find all-gather result shapes carrying the full [layers, ...] axis
+    op_re = re.compile(
+        r"^\s*(?:ROOT\s+)?%?\S+\s*=\s*(\([^)]*\)|\S+)\s*all-gather(?:-start)?\(")
+    full_stack = 0
+    for line in text.splitlines():
+        m = op_re.match(line)
+        if not m:
+            continue
+        for dims in re.findall(r"\[([\d,]+)\]", m.group(1)):
+            parts = [int(x) for x in dims.split(",") if x]
+            if parts[:1] == [layers] or parts[1:2] == [layers]:
+                full_stack += 1
+                break
+    assert full_stack > 0, (
+        "no full-layer-stack all-gathers: XLA now slices scan-stacked "
+        "leaves per layer — re-evaluate zero_8b's unrolled-leaves choice"
+    )
