@@ -65,16 +65,18 @@ __all__ = ["flash_attention", "flash_attention_with_lse", "make_flash_attention_
 _NEG_INF = -1e30  # finite mask sentinel (real scores can never reach it)
 _MASK_THRESH = -0.5e30  # "was this entry masked" test after sentinel fill
 _LANES = 128
-# Lane width for the per-row scalars (lse, corr).  The backward re-reads
-# one scalar tile per (q-block, k-block) pair, so 128-lane replication is
-# ~1.8 GB of HBM traffic per 134M layer (r3 advisor finding) and 8 lanes
-# would be ~0.1 GB — but the END-TO-END A/B (2 interleaved passes of
-# benchmarks/llama.py per variant, r4) measured 8 lanes 3-4% SLOWER:
-# Mosaic's narrow (512x8 f32) input DMA costs more than the fat
-# replicated reads, which the fwd+bwd overlap evidently hides.  128
-# stays the default; the knob records the experiment and serves future
-# hardware.  (Microbenchmark A/Bs through this tunnel are useless —
-# spreads >100% — hence the end-to-end protocol.)
+# Total lane width of the per-row-scalar tiles.  The forward's lse
+# output uses the full width; the backward packs BOTH scalars (lse, corr)
+# into one tile of this width — each gets _SCALAR_LANES/2 lanes — and
+# re-reads one such tile per (q-block, k-block) pair.  History (r4
+# end-to-end A/Bs, 2 interleaved benchmarks/llama.py passes per variant;
+# microbenchmarks through this tunnel are useless, spreads >100%):
+# separate 128-lane lse/corr arrays = ~1.8 GB of re-reads per 134M layer
+# (r3 advisor finding); narrowing them to 8 lanes measured 3-4% SLOWER
+# (Mosaic's narrow 512x8 f32 DMA costs more than the fat reads, which
+# fwd+bwd overlap hides); packing both into one 128-lane tile (half the
+# bytes, fat DMA) measured +1% and ships.  Values other than 128 were
+# measured only in the pre-packing layout.
 _SCALAR_LANES = int(os.environ.get("BLUEFOG_FLASH_SCALAR_LANES", "128"))
 _ALIGNED_ENABLED = os.environ.get("BLUEFOG_FLASH_ALIGNED", "1") != "0"
 _MAX_UNROLL = 64  # triangular fast paths unroll at most this many k blocks
@@ -407,17 +409,19 @@ def _blockwise_fwd_xla(q, k, v, q_start, k_start, *, scale, causal, block_k,
     return out, lse
 
 
-def _bwd_dkv_kernel(qs_ref, ks_ref, q_ref, g_ref, lse_ref, corr_ref,
+def _bwd_dkv_kernel(qs_ref, ks_ref, q_ref, g_ref, aux_ref,
                     k_ref, v_ref, dk_ref, dv_ref, dk_acc, dv_acc,
                     *, scale: float, block_q: int, block_k: int,
-                    causal: bool, num_q: int, aligned_delta):
+                    causal: bool, num_q: int, aligned_delta, half: int):
     """One (bh, jk, iq) program: fold q-block iq into dK/dV of k-block jk.
 
     Same recompute-from-lse trick as the XLA backward, but the
     [block_q, block_k] probability/score tiles live and die in VMEM —
     the XLA path materializes them per k-block in HBM, which is why the
     backward measured memory-bound (docs/STATUS.md round-3 decomposition).
-    ``aligned_delta``: see :func:`_fwd_kernel`.
+    ``aligned_delta``: see :func:`_fwd_kernel`.  ``aux_ref`` packs the two
+    per-row scalars in one tile (lse in lanes [:half], corr in [half:]) —
+    one scalar DMA per grid step instead of two.
     """
     jk = pl.program_id(1)
     iq = pl.program_id(2)
@@ -434,8 +438,8 @@ def _bwd_dkv_kernel(qs_ref, ks_ref, q_ref, g_ref, lse_ref, corr_ref,
         g = g_ref[0]  # [block_q, D]
         k = k_ref[0]  # [block_k, D]
         v = v_ref[0]  # [block_k, D]
-        lse = lse_ref[0][:, :1]  # [block_q, 1] (lane-replicated input)
-        corr = corr_ref[0][:, :1]
+        lse = aux_ref[0][:, :1]  # [block_q, 1] (lane-replicated halves)
+        corr = aux_ref[0][:, half:half + 1]
         qk = q * jnp.asarray(scale, q_ref.dtype) if fold else q
         s = jax.lax.dot_general(
             qk, k, (((1,), (1,)), ((), ())),
@@ -492,12 +496,13 @@ def _bwd_dkv_kernel(qs_ref, ks_ref, q_ref, g_ref, lse_ref, corr_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(qs_ref, ks_ref, q_ref, g_ref, lse_ref, corr_ref,
+def _bwd_dq_kernel(qs_ref, ks_ref, q_ref, g_ref, aux_ref,
                    k_ref, v_ref, dq_ref, dq_acc,
                    *, scale: float, block_q: int, block_k: int,
-                   causal: bool, num_k: int, aligned_delta):
+                   causal: bool, num_k: int, aligned_delta, half: int):
     """One (bh, iq, jk) program: fold k-block jk into dQ of q-block iq.
-    ``aligned_delta``: see :func:`_fwd_kernel`."""
+    ``aligned_delta``: see :func:`_fwd_kernel`; ``aux_ref``/``half``: see
+    :func:`_bwd_dkv_kernel`."""
     iq = pl.program_id(1)
     jk = pl.program_id(2)
 
@@ -512,8 +517,8 @@ def _bwd_dq_kernel(qs_ref, ks_ref, q_ref, g_ref, lse_ref, corr_ref,
         g = g_ref[0]
         k = k_ref[0]
         v = v_ref[0]
-        lse = lse_ref[0][:, :1]
-        corr = corr_ref[0][:, :1]
+        lse = aux_ref[0][:, :1]
+        corr = aux_ref[0][:, half:half + 1]
         qk = q * jnp.asarray(scale, q_ref.dtype) if fold else q
         s = jax.lax.dot_general(
             qk, k, (((1,), (1,)), ((), ())),
@@ -577,21 +582,24 @@ def _flash_bwd_pallas(q, k, v, lse, corr, q_start, k_start, g,
 
     qs = jnp.asarray(q_start, jnp.int32).reshape(1, 1)
     ks = jnp.asarray(k_start, jnp.int32).reshape(1, 1)
-    # per-row scalars ride at _SCALAR_LANES lanes: each (q-block, k-block)
-    # grid step re-reads its scalar tile, so lane count multiplies HBM
-    # traffic (128 lanes measured ~1.8 GB per 134M layer; 8 lanes ~0.1 GB)
-    lse_b = jnp.broadcast_to(lse[..., None], (bh, tq, _SCALAR_LANES))
-    corr_b = jnp.broadcast_to(corr[..., None], (bh, tq, _SCALAR_LANES))
+    # per-row scalars ride lane-replicated, PACKED in one array (lse in
+    # lanes [:half], corr in [half:]): the packed tile is the SAME width
+    # as ONE of the old separate lse/corr tiles, so each (q-block,
+    # k-block) grid step reads half the scalar bytes in one DMA instead
+    # of two (the separate 128-lane arrays measured ~1.8 GB of re-reads
+    # per 134M layer, r3 advisor finding)
+    half = max(_SCALAR_LANES // 2, 1)
+    aux = jnp.concatenate(
+        [jnp.broadcast_to(lse[..., None], (bh, tq, half)),
+         jnp.broadcast_to(corr[..., None], (bh, tq, half))], axis=-1)
 
     smem = pl.BlockSpec((1, 1), lambda *_: (0, 0), memory_space=pltpu.SMEM)
 
-    def rowspec(index):  # q/g/lse/corr blocks, selected by the q index
+    def rowspec(index):  # q/g/aux blocks, selected by the q index
         return [
             _block_spec((1, block_q, d), lambda b, x, y: (b, index(x, y), 0)),
             _block_spec((1, block_q, d), lambda b, x, y: (b, index(x, y), 0)),
-            _block_spec((1, block_q, _SCALAR_LANES),
-                        lambda b, x, y: (b, index(x, y), 0)),
-            _block_spec((1, block_q, _SCALAR_LANES),
+            _block_spec((1, block_q, 2 * half),
                         lambda b, x, y: (b, index(x, y), 0)),
         ]
 
@@ -604,7 +612,7 @@ def _flash_bwd_pallas(q, k, v, lse, corr, q_start, k_start, g,
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, block_q=block_q, block_k=block_k,
-            causal=causal, num_q=num_q, aligned_delta=aligned),
+            causal=causal, num_q=num_q, aligned_delta=aligned, half=half),
         grid=(bh, num_k, num_q),
         in_specs=[smem, smem,
                   *rowspec(lambda j, i: i), *kvspec(lambda j, i: j)],
@@ -621,12 +629,12 @@ def _flash_bwd_pallas(q, k, v, lse, corr, q_start, k_start, g,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qs, ks, q, g, lse_b, corr_b, k, v)
+    )(qs, ks, q, g, aux, k, v)
 
     dq, = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, block_q=block_q, block_k=block_k,
-            causal=causal, num_k=num_k, aligned_delta=aligned),
+            causal=causal, num_k=num_k, aligned_delta=aligned, half=half),
         grid=(bh, num_q, num_k),
         in_specs=[smem, smem,
                   *rowspec(lambda i, j: i), *kvspec(lambda i, j: j)],
@@ -640,7 +648,7 @@ def _flash_bwd_pallas(q, k, v, lse, corr, q_start, k_start, g,
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qs, ks, q, g, lse_b, corr_b, k, v)
+    )(qs, ks, q, g, aux, k, v)
     return dq, dk, dv
 
 
