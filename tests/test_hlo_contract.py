@@ -221,6 +221,82 @@ def test_zero_packed_one_gather_one_scatter():
     assert counts.get("all-reduce", 0) <= 2, counts
 
 
+def test_tp_block_is_one_allreduce():
+    """Megatron column->row parallel MLP, grads w.r.t. both kernels: the
+    forward's psum (the g operator) is the ONLY collective — the f
+    operator's custom VJP keeps the backward free of extra reductions and
+    nothing may all-gather the sharded kernels."""
+    from bluefog_tpu.parallel import tensor_parallel as tp
+
+    ctx = basics.context()
+
+    def loss(x, k1, k2):
+        h = tp.column_parallel_dense(x, k1)
+        y = tp.row_parallel_dense(jnp.tanh(h), k2, axis_name=NODES_AXIS)
+        return jnp.sum(y ** 2)
+
+    fn = jax.shard_map(
+        jax.grad(loss, argnums=(1, 2)), mesh=ctx.mesh,
+        in_specs=(P(), P(None, NODES_AXIS), P(NODES_AXIS, None)),
+        out_specs=(P(None, NODES_AXIS), P(NODES_AXIS, None)))
+    counts = collective_counts(_compiled_text(
+        fn, jnp.ones((4, 16)), jnp.ones((16, 32)), jnp.ones((32, 16))))
+    _assert_only(counts, {"all-reduce": 1})
+
+
+def test_pp_fwd_bwd_is_two_permutes_one_allreduce():
+    """GPipe pipeline fwd+bwd: ONE collective-permute per scan body (fwd
+    stream + its transpose) and the masked result psum — stage-to-stage
+    traffic must stay nearest-neighbor, never an all-gather."""
+    from bluefog_tpu.parallel import pipeline as pp
+
+    ctx = basics.context()
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p)
+
+    def loss(x, params):
+        return jnp.sum(pp.pipeline_apply(
+            stage_fn, params[0], x, NODES_AXIS, num_microbatches=SIZE) ** 2)
+
+    fn = jax.shard_map(jax.grad(loss, argnums=1), mesh=ctx.mesh,
+                       in_specs=(P(), P(NODES_AXIS)),
+                       out_specs=P(NODES_AXIS))
+    counts = collective_counts(_compiled_text(
+        fn, jnp.ones((SIZE, 4, 16)), jnp.ones((SIZE, 16, 16))))
+    _assert_only(counts, {"collective-permute": 2, "all-reduce": 1})
+
+
+def test_ep_fwd_bwd_is_three_alltoalls_one_allreduce():
+    """Switch-MoE fwd+bwd: the dispatch/return all_to_all pair plus their
+    (merged) transpose and the aux-loss reduction — token routing must
+    ride all_to_all, never gather the full token or expert set."""
+    from bluefog_tpu.parallel import expert as ep
+
+    ctx = basics.context()
+    D, F, E = 16, 32, SIZE  # one expert per device
+    p = ep.init_moe_params(jax.random.PRNGKey(1), D, F, E)
+    stacked = {
+        "router": jnp.broadcast_to(p["router"][None],
+                                   (SIZE,) + p["router"].shape),
+        "wi": p["wi"].reshape((SIZE, E // SIZE) + p["wi"].shape[1:]),
+        "wo": p["wo"].reshape((SIZE, E // SIZE) + p["wo"].shape[1:]),
+    }
+
+    def loss(x, p):
+        local = jax.tree_util.tree_map(lambda a: a[0], p)
+        y, aux = ep.switch_moe(x[0], local, NODES_AXIS,
+                               capacity_factor=float(E))
+        return jnp.sum(y ** 2) + jnp.sum(aux)
+
+    espec = jax.tree_util.tree_map(lambda a: P(NODES_AXIS), stacked)
+    fn = jax.shard_map(jax.grad(loss, argnums=1), mesh=ctx.mesh,
+                       in_specs=(P(NODES_AXIS), espec), out_specs=espec)
+    counts = collective_counts(_compiled_text(
+        fn, jnp.ones((SIZE, 4, D)), stacked))
+    _assert_only(counts, {"all-to-all": 3, "all-reduce": 1})
+
+
 def test_scan_stacked_leaves_gather_whole_pinned():
     """Pin scan_gather_probe's finding (its docstring demands a re-run
     "before relying on it" after upgrades): under FSDP+GSPMD, scan-stacked
