@@ -232,6 +232,18 @@ def _supervise(ranks, timeout: float) -> int:
     except KeyboardInterrupt:
         teardown(signal.SIGINT)
         code = 130
+    finally:
+        # _kill_remote's rm -f only reaches STILL-LIVE ranks, so cleanly
+        # exited remote ranks leaked their pidfiles on every return path
+        # (incl. timeout).  Collect them here, one ssh per host (idempotent;
+        # finally covers the early `return 124` too).
+        by_host = {}
+        for rk in ranks:
+            if rk.remote:
+                by_host.setdefault(rk.host, []).append(rk.pidfile)
+        for host, pfs in sorted(by_host.items()):
+            _ssh_best_effort(
+                host, "rm -f " + " ".join(shlex.quote(p) for p in pfs))
     return code
 
 
