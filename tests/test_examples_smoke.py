@@ -39,6 +39,49 @@ def test_example_runs_and_loss_finite(script, args):
     assert "done:" in proc.stdout
 
 
+@pytest.mark.parametrize("max_passes", [1, 4],
+                         ids=["degenerate-single-pass", "adaptive"])
+def test_bench_emits_strict_json(max_passes):
+    """bench.py's stdout contract: exactly ONE line of STRICT JSON with
+    the required keys.  max_passes=1 pins the degenerate single-pass path
+    (spread must print 0.0, never a non-RFC Infinity token — r4 review
+    finding); max_passes=4 exercises the adaptive loop + session-ceiling
+    emission."""
+    import json
+
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        PYTHONPATH=REPO,
+        BENCH_STEPS="2",
+        BENCH_WARMUP="1",
+        BENCH_MAX_PASSES=str(max_passes),
+        BENCH_BUDGET_S="180",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])  # json.loads default REJECTS nothing...
+    # ...so re-check strictness explicitly: the RFC forbids Infinity/NaN
+    assert "Infinity" not in lines[0] and "NaN" not in lines[0], lines[0]
+    for key in ("metric", "value", "unit", "vs_baseline", "spread_pct",
+                "passes"):
+        assert key in rec, rec
+    assert rec["passes"] <= max_passes
+    if max_passes == 1:
+        assert rec["spread_pct"] == 0.0
+    else:
+        # the session-ceiling phase is try/except-guarded in bench.py, so
+        # a regression there would otherwise vanish silently
+        assert "session_ceiling_img_s" in rec, rec
+        assert "ratio_to_session_ceiling" in rec, rec
+
+
 def test_async_islands_example():
     """The asynchronous-islands demo (true multi-process one-sided ops):
     exact async consensus + gossip SGD agreement across 4 island
