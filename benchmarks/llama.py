@@ -85,7 +85,7 @@ def main():
     ap.add_argument("--blocks", type=int, default=0,
                     help="override the flash block_q=block_k size (A/B sweeps)")
     ap.add_argument("--remat-policy", default=None,
-                    choices=[None, "dots", "dots_no_batch"],
+                    choices=[None, "dots", "dots_no_batch", "attn"],
                     help="checkpoint policy under remat presets (A/B sweeps)")
     ap.add_argument("--kv-heads", type=int, default=0,
                     help="grouped-query attention: kv head count "

@@ -255,23 +255,24 @@ def test_llama_scan_layers_matches_unrolled():
     )
 
 
-def test_llama_remat_policy_matches_full_remat():
-    """remat_policy='dots' changes WHAT is saved for the backward pass,
-    never the function: outputs and gradients must match full remat."""
+@pytest.mark.parametrize("policy", ["dots", "attn"])
+def test_llama_remat_policy_matches_full_remat(policy):
+    """remat_policy changes WHAT is saved for the backward pass, never
+    the function: outputs and gradients must match full remat."""
     from bluefog_tpu.models.transformer import LlamaLM
 
     kw = dict(vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
               dff=64, dtype=jnp.float32, scan_layers=True, remat=True)
     ids = jnp.ones((2, 8), jnp.int32)
     m_full = LlamaLM(**kw)
-    m_dots = LlamaLM(**kw, remat_policy="dots")
+    m_pol = LlamaLM(**kw, remat_policy=policy)
     p = m_full.init(jax.random.PRNGKey(0), ids)["params"]
 
     def loss(m, p):
         return jnp.sum(m.apply({"params": p}, ids) ** 2)
 
     l1, g1 = jax.value_and_grad(lambda p: loss(m_full, p))(p)
-    l2, g2 = jax.value_and_grad(lambda p: loss(m_dots, p))(p)
+    l2, g2 = jax.value_and_grad(lambda p: loss(m_pol, p))(p)
     np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), rtol=1e-6)
     for a, b in zip(jax.tree_util.tree_leaves(g1),
                     jax.tree_util.tree_leaves(g2)):
