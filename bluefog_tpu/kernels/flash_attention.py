@@ -106,11 +106,19 @@ def _block_spec(shape, index_map):
 
 
 def _default_blocks(tq, tk, block_q, block_k):
-    """Sequence-adaptive block defaults, measured on v5e fwd+bwd:
-    512x512 is fastest at T=2048 (12.4->9.8 ms vs 256x256, D=64 and D=128);
-    at T=8192 bigger tiles amortize the carried softmax state better —
-    1024x1024 measures 30.1 ms vs 41.1 for 512x512 (47->64 TFLOP/s)."""
-    big = max(tq, tk) >= 8192
+    """Sequence-adaptive block defaults, measured on v5e fwd+bwd.
+
+    History: 512x512 measured fastest at T=2048 in round 2 (12.4->9.8 ms
+    vs 256x256) and 1024x1024 won only at T>=8192 (30.1 vs 41.1 ms) — but
+    that tuning predates the aligned fast path (interior causal tiles now
+    run ZERO mask VPU work), which shifts the balance toward bigger tiles:
+    re-measured END-TO-END in round 4 with the aligned path, 1024x1024 at
+    T=2048 is +14% on Llama-134M training (81.8k -> 93.2k tok/s, D=64,
+    interleaved same-session) and +7% on Llama-1B (14.06k -> 15.03k,
+    D=128).  2048x2048 fails to compile (a [2048, 2048] f32 score tile
+    plus accumulators exceeds what Mosaic will carry).  So: 1024 whenever
+    the sequence admits it, 512 below."""
+    big = max(tq, tk) >= 2048
     if block_q is None:
         block_q = 1024 if big else 512
     if block_k is None:
