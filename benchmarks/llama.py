@@ -48,22 +48,24 @@ PRESETS = {
     "small": dict(vocab=32000, hidden=768, layers=12, heads=12, dff=2048,
                   seq=2048, batch=8, head_chunks=8),
     # ~1.05B (BASELINE config #5 feasibility on one 16 GB chip): bf16
-    # compute, per-block remat, momentum-SGD — params+momentum+grads are
-    # 3 f32 copies = 12.6 GB, AdamW's 4 would not fit single-chip
+    # compute, per-block remat, momentum-SGD with a bf16 momentum trace
+    # (optax accumulator_dtype; AdamW's extra state would not fit
+    # single-chip regardless of trace dtype)
     # scan_layers: one block body in the HLO — 24 unrolled 1B-scale blocks
     # crash the remote-compile service (measured round 2)
     # head_chunks: chunked LM loss — the full [B,T,32k] f32 logits (+their
-    # backward cotangent) are ~2.1 GB at B=4; chunking frees that buffer.
-    # Measured (same session): chunked == full-logits throughput at B=4
-    # (13.08k vs 13.02k tok/s); batch 8 STILL OOMs (by 0.6 GB: the f32
-    # params+grads+momentum = 12.6 GB dominate, not the head); batch 6
-    # is 12% SLOWER (11.5k — non-power-of-2 batch tiles the MXU badly);
-    # batch 8 + --optimizer sgdm_bf16 (bf16 momentum frees 2.1 GB) FITS
-    # but is throughput-NEUTRAL (13.11k) — B=4's matmuls already
-    # saturate the MXU.  B=4 + chunked head + f32 sgdm stands.
+    # backward cotangent) never materialize.
+    # Batch/optimizer history: under the old 512^2 flash blocks B=4+f32
+    # sgdm and B=8+sgdm_bf16 were throughput-NEUTRAL (13.08k vs 13.11k
+    # tok/s) so exact-f32 momentum stayed default; the r4 1024^2 block
+    # retune flipped that — B=8+sgdm_bf16 measured 15.44k vs B=4's
+    # 15.03k (+2.7%, reproduced 15,440/15,449) and is now the preset.
+    # B=4+f32 momentum remains available via --batch 4 --optimizer sgdm
+    # (B=8+f32 OOMs: 12.6 GB of f32 state; B=16 OOMs even bf16;
+    # B=6 measured 12% slower — non-power-of-2 MXU tiling).
     "1b": dict(vocab=32000, hidden=1792, layers=24, heads=14, dff=4864,
-               seq=2048, batch=4, remat=True, scan_layers=True,
-               optimizer="sgdm", head_chunks=8),
+               seq=2048, batch=8, remat=True, scan_layers=True,
+               optimizer="sgdm_bf16", head_chunks=8),
     "tiny": dict(vocab=256, hidden=64, layers=2, heads=4, dff=128,
                  seq=128, batch=2),
 }

@@ -79,6 +79,25 @@ _LANES = 128
 # measured only in the pre-packing layout.
 _SCALAR_LANES = int(os.environ.get("BLUEFOG_FLASH_SCALAR_LANES", "128"))
 _ALIGNED_ENABLED = os.environ.get("BLUEFOG_FLASH_ALIGNED", "1") != "0"
+# Experiment knob (MEASURED NULL, default off): run the kernels' softmax
+# recurrences in base-2 (exp2/log2) with scale*log2(e) folded into the q
+# operand — the FA2 CUDA trick.  The (o, lse) contract stays natural-log
+# (lse converted at kernel finish), so ring merges and the XLA paths are
+# unaffected.  Numerics: the folded multiplier is never a power of two, so
+# q rounds once in its storage dtype (<= 2^-9 relative on bf16 scores;
+# exact-ish on f32/CPU); all CPU-interpret numerics tests pass either way.
+# r4 end-to-end A/B (2 interleaved benchmarks/llama.py rounds, 134M,
+# 1024^2 blocks): off 92.3/93.0 vs on 92.5/87.6 tok/s — within noise to
+# negative; Mosaic's natural exp evidently already lowers to the cheap
+# path, so the saved multiply buys nothing on this chip.
+_EXP2_ENABLED = os.environ.get("BLUEFOG_FLASH_EXP2", "0") != "0"
+_LOG2E = math.log2(math.e)
+_LN2 = math.log(2.0)
+
+
+def _kexp(x):
+    """exp in the kernel's score space (base-2 when _EXP2_ENABLED)."""
+    return jnp.exp2(x) if _EXP2_ENABLED else jnp.exp(x)
 _MAX_UNROLL = 64  # triangular fast paths unroll at most this many k blocks
 
 
@@ -210,13 +229,15 @@ def _fwd_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         # [block_q, D] q operand: a D-wide VPU pass replaces a
         # block_k-wide one on the scores.
         q = q_ref[0]  # [block_q, D]
-        if fold:
+        if _EXP2_ENABLED:
+            q = q * jnp.asarray(scale * _LOG2E, q_ref.dtype)
+        elif fold:
             q = q * jnp.asarray(scale, q_ref.dtype)
         k = k_ref[0]  # [block_k, D]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [block_q, block_k] fp32
-        if not fold:
+        )  # [block_q, block_k] fp32 (base-2 space under _EXP2_ENABLED)
+        if not fold and not _EXP2_ENABLED:
             s = s * scale
         sentinel_rows = False
         if masked:
@@ -237,8 +258,8 @@ def _fwd_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_prev = m_ref[:, :1]  # [block_q, 1] (replicated columns)
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)  # [block_q, 1]
-        p = jnp.exp(s - m_new)  # [block_q, block_k]
+        alpha = _kexp(m_prev - m_new)  # [block_q, 1]
+        p = _kexp(s - m_new)  # [block_q, block_k]
         if sentinel_rows:
             # fully-masked rows have m_new == sentinel and would otherwise
             # contribute exp(0) == 1 per entry
@@ -267,8 +288,12 @@ def _fwd_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _finish():
         l = l_ref[:, :1]
         o_ref[0] = (acc[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-        lse = m_ref[:, :_SCALAR_LANES] + jnp.log(
-            jnp.maximum(l_ref[:, :_SCALAR_LANES], 1e-30))
+        # lse contract is natural-log regardless of the kernel's score
+        # space: base-2 m converts via ln(2)
+        m_fin = m_ref[:, :_SCALAR_LANES]
+        if _EXP2_ENABLED:
+            m_fin = m_fin * _LN2
+        lse = m_fin + jnp.log(jnp.maximum(l_ref[:, :_SCALAR_LANES], 1e-30))
         lse_ref[0] = lse.astype(jnp.float32)
 
 
@@ -448,12 +473,16 @@ def _bwd_dkv_kernel(qs_ref, ks_ref, q_ref, g_ref, aux_ref,
         v = v_ref[0]  # [block_k, D]
         lse = aux_ref[0][:, :1]  # [block_q, 1] (lane-replicated halves)
         corr = aux_ref[0][:, half:half + 1]
-        qk = q * jnp.asarray(scale, q_ref.dtype) if fold else q
+        if _EXP2_ENABLED:
+            qk = q * jnp.asarray(scale * _LOG2E, q_ref.dtype)
+            lse = lse * _LOG2E  # natural-log input -> base-2 space
+        else:
+            qk = q * jnp.asarray(scale, q_ref.dtype) if fold else q
         s = jax.lax.dot_general(
             qk, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [block_q, block_k] fp32
-        if not fold:
+        if not fold and not _EXP2_ENABLED:
             s = s * scale
         if masked:
             if aligned_delta is None:
@@ -465,11 +494,11 @@ def _bwd_dkv_kernel(qs_ref, ks_ref, q_ref, g_ref, aux_ref,
             else:
                 s = _aligned_mask(s, block_q, block_k, aligned_delta)
             # masked entries (and whole sentinel-lse rows) exp to exactly 0
-            p = jnp.exp(jnp.where(s > _MASK_THRESH, s - lse, _NEG_INF))
+            p = _kexp(jnp.where(s > _MASK_THRESH, s - lse, _NEG_INF))
         else:
             # interior tile: nothing is masked and (aligned path) no
             # sentinel-lse row can appear here — plain recompute
-            p = jnp.exp(s - lse)
+            p = _kexp(s - lse)
         dv_acc[...] += jax.lax.dot_general(
             p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -527,12 +556,16 @@ def _bwd_dq_kernel(qs_ref, ks_ref, q_ref, g_ref, aux_ref,
         v = v_ref[0]
         lse = aux_ref[0][:, :1]
         corr = aux_ref[0][:, half:half + 1]
-        qk = q * jnp.asarray(scale, q_ref.dtype) if fold else q
+        if _EXP2_ENABLED:
+            qk = q * jnp.asarray(scale * _LOG2E, q_ref.dtype)
+            lse = lse * _LOG2E  # natural-log input -> base-2 space
+        else:
+            qk = q * jnp.asarray(scale, q_ref.dtype) if fold else q
         s = jax.lax.dot_general(
             qk, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        if not fold:
+        if not fold and not _EXP2_ENABLED:
             s = s * scale
         if masked:
             if aligned_delta is None:
@@ -543,9 +576,9 @@ def _bwd_dq_kernel(qs_ref, ks_ref, q_ref, g_ref, aux_ref,
                 s = jnp.where(kpos <= qpos, s, _NEG_INF)
             else:
                 s = _aligned_mask(s, block_q, block_k, aligned_delta)
-            p = jnp.exp(jnp.where(s > _MASK_THRESH, s - lse, _NEG_INF))
+            p = _kexp(jnp.where(s > _MASK_THRESH, s - lse, _NEG_INF))
         else:
-            p = jnp.exp(s - lse)
+            p = _kexp(s - lse)
         dp = jax.lax.dot_general(
             g, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
