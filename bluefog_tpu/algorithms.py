@@ -99,7 +99,7 @@ def gradient_tracking_spmd(
     lr = float(learning_rate)
 
     def comm(tree):
-        return ops_spmd.neighbor_allreduce(tree, plan, axis_name)
+        return ops_spmd.neighbor_allreduce(tree, plan, axis_name, fuse=True)
 
     def init(params):
         z = jax.tree_util.tree_map(jnp.zeros_like, params)
@@ -138,7 +138,7 @@ def extra_spmd(
     lr = float(learning_rate)
 
     def wt(tree):
-        mixed = ops_spmd.neighbor_allreduce(tree, plan, axis_name)
+        mixed = ops_spmd.neighbor_allreduce(tree, plan, axis_name, fuse=True)
         return jax.tree_util.tree_map(lambda m, t: 0.5 * (m + t), mixed, tree)
 
     def init(params):
@@ -191,7 +191,7 @@ def push_diging_spmd(
     lr = float(learning_rate)
 
     def comm(tree):
-        return ops_spmd.neighbor_allreduce(tree, plan, axis_name)
+        return ops_spmd.neighbor_allreduce(tree, plan, axis_name, fuse=True)
 
     def init(params):
         z = jax.tree_util.tree_map(jnp.zeros_like, params)

@@ -85,6 +85,7 @@ def neighbor_allreduce(
     *,
     self_weight: Optional[float] = None,
     average_dtype=None,
+    fuse: bool = False,
 ):
     """Weighted neighbor averaging: ``out_d = w_dd * x_d + sum_{s in N_in(d)}
     w_ds * x_s`` — the reference's hot path (SURVEY.md §3.2).
@@ -93,6 +94,16 @@ def neighbor_allreduce(
     constant vectors indexed by ``axis_index`` so a single compiled program
     serves every rank (SPMD).  ``self_weight`` overrides the plan's per-rank
     self weights uniformly.
+
+    ``fuse=True`` packs same-dtype leaves into ONE flat buffer before
+    permuting — the reference's fusion buffer (``BLUEFOG_FUSION_THRESHOLD``,
+    ``operations.cc`` [U]) realized on the SPMD path: exactly one ppermute
+    per (shift class, dtype group) regardless of pytree width, GUARANTEED
+    rather than left to XLA's collective combiner (which merges same-shaped
+    permutes but leaves odd-shaped scalars — e.g. a push-sum weight —
+    riding their own collective).  Exact: the weighted combine is linear
+    and the per-edge weights are leaf-independent.  Output leaves are in
+    their accumulation dtype, same as the unfused path.
     """
 
     def nar(a):
@@ -114,6 +125,20 @@ def neighbor_allreduce(
             acc = acc + w * recvd
         return acc
 
+    leaves, treedef = jax.tree_util.tree_flatten(x)
+    if fuse and len(leaves) > 1:
+        groups = {}  # dtype -> leaf positions, insertion-ordered
+        for i, leaf in enumerate(leaves):
+            groups.setdefault(jnp.dtype(leaf.dtype), []).append(i)
+        out = [None] * len(leaves)
+        for idxs in groups.values():
+            mixed = nar(jnp.concatenate([leaves[i].ravel() for i in idxs]))
+            off = 0
+            for i in idxs:
+                n = leaves[i].size
+                out[i] = mixed[off:off + n].reshape(leaves[i].shape)
+                off += n
+        return jax.tree_util.tree_unflatten(treedef, out)
     return jax.tree_util.tree_map(nar, x)
 
 
