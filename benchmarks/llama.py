@@ -100,6 +100,10 @@ def main():
                     "accumulation (custom-VJP path; measured NEUTRAL "
                     "at 1B and -3%% at 134M on the v5e, where default "
                     "f32 matmul already runs near the bf16 rate)")
+    ap.add_argument("--fuse", action="store_true",
+                    help="gossip the param tree through the fusion buffer "
+                    "(one ppermute per shift class per dtype group; "
+                    "costs a params-sized pack+unpack per round)")
     ap.add_argument("--optimizer", default=None,
                     choices=[None, "adamw", "sgdm", "sgdm_bf16",
                              "adafactor"],
@@ -183,6 +187,9 @@ def main():
         init_fn, step_fn = make_decentralized_train_step(
             lm_apply, opt, ctx.mesh,
             communication_type=comm, plan=plan, loss_fn=lm_loss,
+            # the allreduce baseline phase has no fusion buffer (and
+            # make_spmd_comm_fn raises rather than silently dropping it)
+            comm_fuse=args.fuse and comm == CommunicationType.neighbor_allreduce,
         )
         p = jax.tree_util.tree_map(jnp.asarray, params_host)
         opt_state = init_fn(p)

@@ -76,8 +76,27 @@ def make_spmd_comm_fn(
     axis_name: str = NODES_AXIS,
     machines_axis: str = MACHINES_AXIS,
     local_axis: str = LOCAL_AXIS,
+    fuse: bool = False,
 ) -> CommFn:
-    """Build the in-SPMD communication function for a CommunicationType."""
+    """Build the in-SPMD communication function for a CommunicationType.
+
+    ``fuse`` forwards to :func:`ops_spmd.neighbor_allreduce`'s fusion
+    buffer (one ppermute per shift class per dtype group).  Default off
+    for the training path: packing a large param tree materializes a
+    params-sized pack+unpack per round, trading HBM bandwidth for
+    collective count — the right side of that trade depends on leaf
+    count and interconnect latency, so it is a measured knob, not a
+    default (see docs/STATUS.md round-4 fusion-buffer entry; the exact
+    methods in :mod:`bluefog_tpu.algorithms`, whose trees are small and
+    carry an odd-shaped push-sum scalar, use it unconditionally)."""
+    if fuse and comm_type != CommunicationType.neighbor_allreduce:
+        # silently dropping the flag would poison an A/B (same rationale
+        # as llama.py's --remat-policy guard): only the neighbor path
+        # implements the fusion buffer today
+        raise ValueError(
+            f"fuse=True is only implemented for neighbor_allreduce, "
+            f"not {comm_type}"
+        )
     if comm_type == CommunicationType.empty:
         return lambda x: x
     if comm_type == CommunicationType.allreduce:
@@ -85,7 +104,8 @@ def make_spmd_comm_fn(
     if comm_type == CommunicationType.neighbor_allreduce:
         if plan is None:
             raise ValueError("neighbor_allreduce needs a CommPlan")
-        return lambda x: ops_spmd.neighbor_allreduce(x, plan, axis_name)
+        return lambda x: ops_spmd.neighbor_allreduce(x, plan, axis_name,
+                                                     fuse=fuse)
     if comm_type == CommunicationType.hierarchical_neighbor_allreduce:
         if machine_plan is None:
             raise ValueError("hierarchical_neighbor_allreduce needs a machine CommPlan")
