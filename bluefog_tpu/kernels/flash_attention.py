@@ -68,16 +68,20 @@ _LANES = 128
 # Total lane width of the per-row-scalar tiles.  The forward's lse
 # output uses the full width; the backward packs BOTH scalars (lse, corr)
 # into one tile of this width — each gets _SCALAR_LANES/2 lanes — and
-# re-reads one such tile per (q-block, k-block) pair.  History (r4
-# end-to-end A/Bs, 2 interleaved benchmarks/llama.py passes per variant;
-# microbenchmarks through this tunnel are useless, spreads >100%):
-# separate 128-lane lse/corr arrays = ~1.8 GB of re-reads per 134M layer
-# (r3 advisor finding); narrowing them to 8 lanes measured 3-4% SLOWER
-# (Mosaic's narrow 512x8 f32 DMA costs more than the fat reads, which
-# fwd+bwd overlap hides); packing both into one 128-lane tile (half the
-# bytes, fat DMA) measured +1% and ships.  Values other than 128 were
-# measured only in the pre-packing layout.
-_SCALAR_LANES = int(os.environ.get("BLUEFOG_FLASH_SCALAR_LANES", "128"))
+# re-reads one such tile per (q-block, k-block) pair.  History (all
+# end-to-end interleaved benchmarks/llama.py A/Bs; microbenchmarks
+# through this tunnel are useless, spreads >100%):
+# - r4, 512^2 blocks: separate 128-lane lse/corr arrays = ~1.8 GB of
+#   re-reads per 134M layer (r3 advisor finding); narrowing to 8 lanes
+#   measured 3-4% SLOWER (the narrow 512x8 f32 DMA cost more than the
+#   fat reads, which fwd+bwd overlap hid); packing both scalars into one
+#   128-lane tile (half the bytes, one DMA) measured +1% and shipped.
+# - r4 continuation, 1024^2 blocks (the retuned default): the lane
+#   conclusion FLIPPED — 8 lanes is +5.1% at 134M (97.7k vs 93.0k tok/s,
+#   reproduced 97.8k/97.7k) and +0.9% at 1B (15.60k vs 15.46k): a
+#   1024-row scalar tile amortizes the narrow-DMA overhead that the
+#   512-row tile could not, and 16x fewer scalar bytes win.  8 ships.
+_SCALAR_LANES = int(os.environ.get("BLUEFOG_FLASH_SCALAR_LANES", "8"))
 _ALIGNED_ENABLED = os.environ.get("BLUEFOG_FLASH_ALIGNED", "1") != "0"
 # Experiment knob (MEASURED NULL, default off): run the kernels' softmax
 # recurrences in base-2 (exp2/log2) with scale*log2(e) folded into the q

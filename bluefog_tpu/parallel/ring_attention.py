@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import math
 from functools import partial
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -186,8 +186,8 @@ def ring_flash_attention(
     *,
     causal: bool = True,
     striped: bool = False,
-    block_q: int = 256,
-    block_k: int = 256,
+    block_q: Optional[int] = None,  # None: per-shard sequence-adaptive
+    block_k: Optional[int] = None,  # (kernels._default_blocks)
     interpret: bool = None,
     impl: str = "auto",
 ) -> jnp.ndarray:

@@ -32,7 +32,7 @@ Layout: per-device ``q, k, v: [B, T_local, H, D]``; the global sequence is
 from __future__ import annotations
 
 from functools import partial
-from typing import Callable
+from typing import Callable, Optional
 
 import jax.numpy as jnp
 from jax import lax
@@ -51,8 +51,8 @@ def ulysses_attention(
     *,
     causal: bool = True,
     flash: bool = False,
-    block_q: int = 256,
-    block_k: int = 256,
+    block_q: Optional[int] = None,  # None: per-shard sequence-adaptive
+    block_k: Optional[int] = None,  # (kernels._default_blocks)
     interpret: bool = None,
     impl: str = "auto",
 ) -> jnp.ndarray:

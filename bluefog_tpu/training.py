@@ -148,6 +148,13 @@ def make_decentralized_train_step(
         axis_name = NODES_AXIS
 
     if communication_type == CommunicationType.allreduce:
+        if comm_fuse:
+            # this branch never reaches make_spmd_comm_fn's guard, so it
+            # must raise itself — a silently dropped flag poisons A/Bs
+            raise ValueError(
+                "comm_fuse=True is only implemented for "
+                "neighbor_allreduce, not CommunicationType.allreduce"
+            )
         tx = gradient_allreduce_spmd(
             base_optimizer, axis_name, num_steps_per_communication
         )
