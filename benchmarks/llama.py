@@ -100,6 +100,9 @@ def main():
                     "accumulation (custom-VJP path; measured NEUTRAL "
                     "at 1B and -3%% at 134M on the v5e, where default "
                     "f32 matmul already runs near the bf16 rate)")
+    ap.add_argument("--seq", type=int, default=0,
+                    help="override the preset sequence length (long-context "
+                    "runs; pair with --batch to keep tokens/step sane)")
     ap.add_argument("--fuse", action="store_true",
                     help="gossip the param tree through the fusion buffer "
                     "(one ppermute per shift class per dtype group; "
@@ -115,6 +118,8 @@ def main():
     cfg = dict(PRESETS[args.preset])
     if args.batch:
         cfg["batch"] = args.batch
+    if args.seq:
+        cfg["seq"] = args.seq
     if args.optimizer:
         cfg["optimizer"] = args.optimizer
     if args.remat_policy and not cfg.get("remat"):
