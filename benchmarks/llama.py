@@ -228,6 +228,11 @@ def main():
     # flops excluded (standard approximation), so this slightly
     # understates true utilization.
     flops_per_tok = 6 * float(n_params)
+    # attention-inclusive utilization: causal QK+PV fwd+bwd add
+    # 6·L·T·d_model flops/token (2·T²·d per matmul pair, halved causal,
+    # ×3 for fwd+bwd) — negligible at S=2048 but the dominant term at
+    # long context, where the 6N lens badly understates real work
+    attn_per_tok = 6.0 * cfg["layers"] * T * cfg["hidden"]
     out = {
         "metric": f"Llama-{args.preset} ({n_params/1e6:.0f}M) tokens/sec/chip "
                   f"(neighbor_allreduce exp2, S={T})",
@@ -235,6 +240,8 @@ def main():
         "unit": "tok/s/chip",
         "vs_baseline": round(t_ar / t_dec, 4),
         "mfu_vs_197tf_bf16": round(toks * flops_per_tok / 197e12, 3),
+        "mfu_attn_incl": round(
+            toks * (flops_per_tok + attn_per_tok) / 197e12, 3),
     }
     stats = getattr(jax.local_devices()[0], "memory_stats", lambda: None)()
     if stats and stats.get("peak_bytes_in_use"):
