@@ -97,12 +97,33 @@ _ALIGNED_ENABLED = os.environ.get("BLUEFOG_FLASH_ALIGNED", "1") != "0"
 _EXP2_ENABLED = os.environ.get("BLUEFOG_FLASH_EXP2", "0") != "0"
 _LOG2E = math.log2(math.e)
 _LN2 = math.log(2.0)
+_MAX_UNROLL = 64  # triangular fast paths unroll at most this many k blocks
 
 
 def _kexp(x):
     """exp in the kernel's score space (base-2 when _EXP2_ENABLED)."""
     return jnp.exp2(x) if _EXP2_ENABLED else jnp.exp(x)
-_MAX_UNROLL = 64  # triangular fast paths unroll at most this many k blocks
+
+
+def _score_operand(q, dtype, scale):
+    """The q matmul operand with the softmax scale folded where possible.
+
+    Returns ``(q_operand, scale_scores)``: under exp2 mode scale*log2(e)
+    always folds into q (one D-wide pass; rounds q once in its storage
+    dtype); otherwise an exact power-of-two scale folds losslessly; any
+    other scale stays on the f32 scores (``scale_scores=True``) —
+    shared by the forward and both backward kernels."""
+    if _EXP2_ENABLED:
+        return q * jnp.asarray(scale * _LOG2E, dtype), False
+    if _scale_folds_exactly(scale):
+        return q * jnp.asarray(scale, dtype), False
+    return q, True
+
+
+def _lse_in_score_space(lse):
+    """Natural-log lse converted to the kernel's score space (base-2
+    under exp2 mode) for the backward recompute ``p = exp(s - lse)``."""
+    return lse * _LOG2E if _EXP2_ENABLED else lse
 
 
 def _use_triangular(causal, tri_delta, tq, tk, num_k):
@@ -221,27 +242,18 @@ def _fwd_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc[...] = jnp.zeros_like(acc)
 
-    fold = _scale_folds_exactly(scale)
-
     def _body(masked):
         # operands stay in their storage dtype (bf16 on TPU — full-rate MXU
         # passes); fp32 happens only in the accumulator via
         # preferred_element_type.  Casting to fp32 first would force the
         # MXU's slow fp32 path and make the kernel slower than dense XLA.
-        # When scale is a power of two (head dims that are powers of 4 —
-        # exact exponent shift, no rounding) it folds into the
-        # [block_q, D] q operand: a D-wide VPU pass replaces a
-        # block_k-wide one on the scores.
-        q = q_ref[0]  # [block_q, D]
-        if _EXP2_ENABLED:
-            q = q * jnp.asarray(scale * _LOG2E, q_ref.dtype)
-        elif fold:
-            q = q * jnp.asarray(scale, q_ref.dtype)
+        # Scale folding: see _score_operand.
+        q, scale_scores = _score_operand(q_ref[0], q_ref.dtype, scale)
         k = k_ref[0]  # [block_k, D]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_q, block_k] fp32 (base-2 space under _EXP2_ENABLED)
-        if not fold and not _EXP2_ENABLED:
+        if scale_scores:
             s = s * scale
         sentinel_rows = False
         if masked:
@@ -468,25 +480,19 @@ def _bwd_dkv_kernel(qs_ref, ks_ref, q_ref, g_ref, aux_ref,
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    fold = _scale_folds_exactly(scale)
-
     def _body(masked):
         q = q_ref[0]  # [block_q, D]
         g = g_ref[0]  # [block_q, D]
         k = k_ref[0]  # [block_k, D]
         v = v_ref[0]  # [block_k, D]
-        lse = aux_ref[0][:, :1]  # [block_q, 1] (lane-replicated halves)
+        lse = _lse_in_score_space(aux_ref[0][:, :1])  # [block_q, 1]
         corr = aux_ref[0][:, half:half + 1]
-        if _EXP2_ENABLED:
-            qk = q * jnp.asarray(scale * _LOG2E, q_ref.dtype)
-            lse = lse * _LOG2E  # natural-log input -> base-2 space
-        else:
-            qk = q * jnp.asarray(scale, q_ref.dtype) if fold else q
+        qk, scale_scores = _score_operand(q, q_ref.dtype, scale)
         s = jax.lax.dot_general(
             qk, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [block_q, block_k] fp32
-        if not fold and not _EXP2_ENABLED:
+        if scale_scores:
             s = s * scale
         if masked:
             if aligned_delta is None:
@@ -551,25 +557,19 @@ def _bwd_dq_kernel(qs_ref, ks_ref, q_ref, g_ref, aux_ref,
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    fold = _scale_folds_exactly(scale)
-
     def _body(masked):
         q = q_ref[0]
         g = g_ref[0]
         k = k_ref[0]
         v = v_ref[0]
-        lse = aux_ref[0][:, :1]
+        lse = _lse_in_score_space(aux_ref[0][:, :1])
         corr = aux_ref[0][:, half:half + 1]
-        if _EXP2_ENABLED:
-            qk = q * jnp.asarray(scale * _LOG2E, q_ref.dtype)
-            lse = lse * _LOG2E  # natural-log input -> base-2 space
-        else:
-            qk = q * jnp.asarray(scale, q_ref.dtype) if fold else q
+        qk, scale_scores = _score_operand(q, q_ref.dtype, scale)
         s = jax.lax.dot_general(
             qk, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        if not fold and not _EXP2_ENABLED:
+        if scale_scores:
             s = s * scale
         if masked:
             if aligned_delta is None:
