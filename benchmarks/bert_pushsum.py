@@ -33,6 +33,7 @@ import optax
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import bluefog_tpu as bf
+from bench import measure_rtt
 from bluefog_tpu import topology_util
 from bluefog_tpu.models.transformer import BertEncoder
 from bluefog_tpu.ops import device_sync
@@ -142,12 +143,26 @@ def main():
     device_sync(loss)
     dt = (time.perf_counter() - t0) / args.iters
 
+    # this loop is EAGER by design (the parity window-op surface:
+    # win_accumulate / win_update / associated-p / set_exposed per round,
+    # plus the jitted grad/update/apply calls), so each step pays several
+    # tunnel round-trips that no RTT *subtraction* can remove — the
+    # measured bimodality (~24k tok/s in fast-RTT sessions vs ~8k when
+    # the tunnel RTT is tens of ms) is the dispatch overhead, not the
+    # window math.  Emit the session RTT so a slow reading self-describes
+    # (the same principle as bench.py's session ceiling).
+    # probe on a constant, not the loss: measure_rtt's _sync asserts
+    # finiteness, and a diverged run should still print its JSON line
+    probe = jax.block_until_ready(jnp.ones(()))
+    rtt_ms = measure_rtt(probe) * 1e3
     out = {
         "metric": f"BERT-{args.preset} ({n_params/1e6:.0f}M) push-sum "
                   f"fine-tune tokens/sec/chip (directed ring, S={T})",
         "value": round(B * T / dt, 1),
         "unit": "tok/s/chip",
         "vs_baseline": 0.0,
+        "session_rtt_ms": round(rtt_ms, 1),
+        "step_ms": round(dt * 1e3, 1),
     }
     stats = getattr(jax.local_devices()[0], "memory_stats", lambda: None)()
     if stats and stats.get("peak_bytes_in_use"):
