@@ -3,10 +3,12 @@
 Two interchangeable forwards behind one ``impl`` switch ("auto" default =
 the Pallas kernel): a hand Pallas kernel and an online-softmax blockwise
 computation in plain XLA (``impl="xla"``).  Forward-only standing (r4
-continuation, benchmarks/attention_fwd_ab.py, scan-chained single-dispatch
-protocol): the Pallas forward is 1.3-3.0x FASTER than the XLA blockwise
-forward at 134M/1B/long-context dims (ratio ranges over repeated runs;
-never below 1.33).  (The r3-era header claimed the
+continuation, benchmarks/attention_fwd_ab.py, scan-chain + slope
+protocol): the Pallas forward is 4-6x FASTER than the XLA blockwise
+forward at 134M/1B/long-context dims (44-82 TF/s vs 9-18; repeatable to
+a few % once the slope estimator cancels the constant per-dispatch
+tunnel overhead that compressed single-region readings to 1.3-3x).
+(The r3-era header claimed the
 reverse — XLA ahead 25-35% — measured at 512^2 blocks before the aligned
 fast path and packed scalar tiles; the r4 kernel work flipped it, closing
 the r3 verdict's "largest known recoverable perf item".)  END-TO-END the
@@ -397,8 +399,8 @@ def _blockwise_fwd_xla(q, k, v, q_start, k_start, *, scale, causal, block_k,
 
     Selectable via ``impl="xla"``.  At the r3-era 512^2 blocks it beat
     the hand kernel forward-only by ~25-35%; after the r4 aligned fast
-    path + 1024^2 retune the Pallas forward is 1.3-3x FASTER
-    (benchmarks/attention_fwd_ab.py), and inside the custom-vjp's
+    path + 1024^2 retune the Pallas forward is 4-6x FASTER
+    (benchmarks/attention_fwd_ab.py, slope protocol), and inside the custom-vjp's
     backward recompute this path measured 13x slower end-to-end on Llama
     training — so it is NOT the auto default on either lens.  Kept as
     the independent same-contract implementation (numerics cross-check,
@@ -797,7 +799,7 @@ def _fwd_dispatch(q, k, v, q_start, k_start, *, scale, causal, block_q,
     on the Llama-134M S=2048 benchmark (4.8k vs 63.0k tok/s/chip): under
     jit the unrolled per-block forward inside the custom-vjp recompute
     blows up the backward's schedule.  (Post-r4-retune the forward-only
-    comparison reversed too — Pallas 1.3-3x faster,
+    comparison reversed too — Pallas 4-6x faster,
     benchmarks/attention_fwd_ab.py.)  Training throughput is the
     headline workload, so auto = Pallas; forward-heavy callers can still
     pass impl="xla"."""

@@ -82,6 +82,30 @@ def test_bench_emits_strict_json(max_passes):
         assert "ratio_to_session_ceiling" in rec, rec
 
 
+def test_attention_fwd_ab_emits_json():
+    """benchmarks/attention_fwd_ab.py (the forward-only Pallas-vs-XLA
+    A/B that re-pinned the r3 'XLA wins fwd-only' claim) must keep
+    running off-TPU and emit its one-line JSON contract — the ratio is
+    meaningless on CPU, the contract is what's pinned."""
+    import json
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks/attention_fwd_ab.py"),
+         "--batch", "1", "--heads", "1", "--seq", "128", "--head-dim", "64",
+         "--chain", "2", "--repeats", "1", "--group", "1"],
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "pallas_ms",
+                "xla_ms"):
+        assert key in rec, rec
+    assert rec["value"] > 0
+
+
 def test_async_islands_example():
     """The asynchronous-islands demo (true multi-process one-sided ops):
     exact async consensus + gossip SGD agreement across 4 island
