@@ -156,7 +156,7 @@ def main():
             # of the one-line contract sees the estimators differ
             print(
                 f"fwd_ab:{impl}: all paired slopes non-positive — raise "
-                "--chain/--group; falling back to the big-region mean "
+                "--chain/--group; falling back to the MIN big region "
                 "(carries the constant per-region overhead the slope "
                 "would have cancelled)",
                 file=sys.stderr,

@@ -27,7 +27,7 @@ import optax
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import _sync, measure_rtt, subtract_rtt
+from bench import _sync, measure_rtt, slope_time
 import bluefog_tpu as bf
 from bluefog_tpu import topology_util
 from bluefog_tpu.core import basics
@@ -202,15 +202,22 @@ def main():
         for _ in range(args.warmup):
             p, _, opt_state, loss, _ = step_fn(p, {}, opt_state, ids, ids)
         _sync(loss)
-        # measure + subtract the sync round-trip (shared guarded helper:
-        # the tunnel's fetch RTT varies 3.5-200 ms between sessions and
-        # would otherwise ride on the timed region once)
-        rt = measure_rtt(loss)
-        t0 = time.perf_counter()
-        for _ in range(args.iters):
-            p, _, opt_state, loss, _ = step_fn(p, {}, opt_state, ids, ids)
-        _sync(loss)
-        return subtract_rtt(time.perf_counter() - t0, rt, args.iters, "llama")
+
+        def region(k):
+            nonlocal p, opt_state, loss
+            t0 = time.perf_counter()
+            for _ in range(k):
+                p, _, opt_state, loss, _ = step_fn(p, {}, opt_state, ids, ids)
+            _sync(loss)
+            return time.perf_counter() - t0
+
+        # shared paired-slope estimator (bench.slope_time — rationale
+        # there): cancels the constant per-region cost, fetch RTT AND
+        # pipeline fill, where the previous (T - rt)/iters left the fill
+        # share in (~5% at 134M's ~20 ms steps with iters=10)
+        t, _ = slope_time(region, args.iters, "llama",
+                          lambda: measure_rtt(loss))
+        return t
 
     t_dec = timed(CommunicationType.neighbor_allreduce, ctx.plan)
     if n == 1 and cfg.get("remat"):
