@@ -76,7 +76,7 @@ def measure_rtt(x, n: int = 3) -> float:
     return (time.perf_counter() - t0) / n
 
 
-def slope_time(region, iters: int, label: str, fallback_rt) -> tuple:
+def paired_slope(region, iters: int, label: str, fallback_rt) -> tuple:
     """Paired-slope per-call estimator, SHARED by every region-timed
     benchmark (bench.py phases, benchmarks/llama.py) so the protocols
     cannot drift apart — same policy as measure_rtt/subtract_rtt.
@@ -134,7 +134,7 @@ def time_steps(step_fn, params, batch_stats, opt_state, batch, labels, warmup,
     """Times per CALL by the PAIRED-SLOPE estimator; with steps_per_call=k
     each call is k real steps.
 
-    Protocol: the shared paired-slope estimator (``slope_time``; history
+    Protocol: the shared paired-slope estimator (``paired_slope``; history
     and rationale there).  The driver-headline drift across rounds
     (2772 -> 2508 -> 2497) was the old estimator's unsubtracted
     pipeline-fill bias moving with session overhead, not a code
@@ -165,7 +165,7 @@ def time_steps(step_fn, params, batch_stats, opt_state, batch, labels, warmup,
         _sync(loss)
         return time.perf_counter() - t0
 
-    return slope_time(region, iters, "resnet", lambda: measure_rtt(loss))
+    return paired_slope(region, iters, "resnet", lambda: measure_rtt(loss))
 
 
 def main():
@@ -304,7 +304,7 @@ def main():
         # value/ceiling compares like with like
         bare_times = []
         for _ in range(3):
-            t_bare_i, used_fb = slope_time(
+            t_bare_i, used_fb = paired_slope(
                 bare_region, iters, "bare", lambda: measure_rtt(loss))
             fallback_passes += int(used_fb)
             bare_times.append(t_bare_i)
@@ -364,7 +364,7 @@ def main():
         "value": round(imgs_per_sec_chip, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(ratio, 4),
-        # paired-slope per-call timing (see slope_time docstring): the
+        # paired-slope per-call timing (see paired_slope docstring): the
         # constant per-region tunnel cost — RTT AND pipeline fill —
         # cancels, where the pre-r4 estimator subtracted only RTT and
         # under-reported by ~12% in slow windows.  estimator_fallbacks

@@ -27,25 +27,31 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import _sync, measure_rtt, subtract_rtt
+from bench import _sync, measure_rtt, paired_slope
 from bluefog_tpu.kernels.flash_attention import flash_attention
 from bluefog_tpu.models.transformer import dense_attention
 
 
 def timed(f, args, iters):
+    """Per-call via the shared paired-slope estimator (bench.paired_slope):
+    the constant per-region cost — fetch RTT AND pipeline fill — cancels
+    in the difference of the two regions, where the previous RTT-only
+    subtraction left the fill share in and pulled small-S ratios toward
+    1 (see the r4 STATUS estimator note)."""
     out = f(*args)
     first = out[0] if isinstance(out, tuple) else out
     _sync(first)
-    # subtract the sync round-trip (3.5-200 ms per tunnel session):
-    # without this, small-S timings measure the RTT and ratios get
-    # pulled toward 1.  Guarded helper: if the timed region does not
-    # dominate the RTT it warns and reports the conservative figure.
-    rt = measure_rtt(first)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = f(*args)
-    _sync(out[0] if isinstance(out, tuple) else out)
-    return subtract_rtt(time.perf_counter() - t0, rt, iters, "attention")
+
+    def region(k):
+        o = None
+        t0 = time.perf_counter()
+        for _ in range(k):
+            o = f(*args)
+        _sync(o[0] if isinstance(o, tuple) else o)
+        return time.perf_counter() - t0
+
+    return paired_slope(region, iters, "attention",
+                        lambda: measure_rtt(first))
 
 
 def main():
@@ -79,8 +85,18 @@ def main():
 
     result = None
     for S in seqs:
+        # size the region so the slope's compute DELTA — the difference
+        # between the iters and iters//2 regions, i.e. ~iters/2 calls —
+        # is ~0.5 s (peaks.py's rule: the estimator is only as good as
+        # the delta it differences; at fixed iters the small-S deltas
+        # are a few ms and drown in region noise).  ~50 TF/s estimate.
+        flops_s = 12 * B * H * S * S * D * 0.5
+        iters = args.iters
+        if on_tpu:
+            est = flops_s / 50e12
+            iters = max(args.iters, min(int(1.0 / est), 2000))
         try:
-            tf = timed(flash_g, qkv(S), args.iters)
+            tf, tf_fb = timed(flash_g, qkv(S), iters)
         except AssertionError:  # _sync's finiteness check: a real kernel bug
             raise
         except Exception as e:  # keep earlier lengths' result on OOM
@@ -88,13 +104,13 @@ def main():
                   file=sys.stderr)
             break
         try:
-            td = timed(dense_g, qkv(S), args.iters)
+            td, td_fb = timed(dense_g, qkv(S), iters)
         except AssertionError:  # _sync's finiteness check: a real bug
             raise
         except Exception:  # dense OOMs first at long S — that's the point
-            td = float("inf")
+            td, td_fb = float("inf"), False
         # causal fwd+bwd useful FLOPs: (4 qk/pv + 2x4 bwd) * 0.5 causal
-        flops = 12 * B * H * S * S * D * 0.5
+        flops = flops_s
         print(
             f"# S={S}: flash {tf * 1e3:8.2f} ms  dense {td * 1e3:8.2f} ms  "
             f"({flops / tf / 1e12:5.1f} TF/s, dense/flash {td / tf:4.2f}x)",
@@ -106,6 +122,10 @@ def main():
             "value": round(flops / tf / 1e12, 2),
             "unit": "TFLOP/s",
             "vs_baseline": round(td / tf, 4) if np.isfinite(td) else None,
+            # paired_slope's contract: flag figures that fell back to
+            # the RTT-subtracted estimator (never mix them up with
+            # slope-timed records)
+            "estimator_fallbacks": int(tf_fb) + int(td_fb),
         }
     print(json.dumps(result))
 

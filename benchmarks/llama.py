@@ -27,7 +27,7 @@ import optax
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import _sync, measure_rtt, slope_time
+from bench import _sync, measure_rtt, paired_slope
 import bluefog_tpu as bf
 from bluefog_tpu import topology_util
 from bluefog_tpu.core import basics
@@ -211,14 +211,17 @@ def main():
             _sync(loss)
             return time.perf_counter() - t0
 
-        # shared paired-slope estimator (bench.slope_time — rationale
+        # shared paired-slope estimator (bench.paired_slope — rationale
         # there): cancels the constant per-region cost, fetch RTT AND
         # pipeline fill, where the previous (T - rt)/iters left the fill
         # share in (~5% at 134M's ~20 ms steps with iters=10)
-        t, _ = slope_time(region, args.iters, "llama",
-                          lambda: measure_rtt(loss))
+        t, fb = paired_slope(region, args.iters, "llama",
+                             lambda: measure_rtt(loss))
+        nonlocal fallbacks
+        fallbacks += int(fb)
         return t
 
+    fallbacks = 0
     t_dec = timed(CommunicationType.neighbor_allreduce, ctx.plan)
     if n == 1 and cfg.get("remat"):
         # single-chip 1B: the exp2 plan has no edges so both phases run the
@@ -249,6 +252,9 @@ def main():
         "mfu_vs_197tf_bf16": round(toks * flops_per_tok / 197e12, 3),
         "mfu_attn_incl": round(
             toks * (flops_per_tok + attn_per_tok) / 197e12, 3),
+        # paired_slope's contract: surface when a phase fell back to the
+        # RTT-subtracted estimator (0 = every figure is slope-timed)
+        "estimator_fallbacks": fallbacks,
     }
     stats = getattr(jax.local_devices()[0], "memory_stats", lambda: None)()
     if stats and stats.get("peak_bytes_in_use"):
