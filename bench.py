@@ -76,7 +76,8 @@ def measure_rtt(x, n: int = 3) -> float:
     return (time.perf_counter() - t0) / n
 
 
-def paired_slope(region, iters: int, label: str, fallback_rt) -> tuple:
+def paired_slope(region, iters: int, label: str, fallback_rt,
+                 repeats: int = 1) -> tuple:
     """Paired-slope per-call estimator, SHARED by every region-timed
     benchmark (bench.py phases, benchmarks/llama.py) so the protocols
     cannot drift apart — same policy as measure_rtt/subtract_rtt.
@@ -92,23 +93,49 @@ def paired_slope(region, iters: int, label: str, fallback_rt) -> tuple:
     ``fallback_rt`` is a zero-arg callable so the 3-sync RTT measurement
     is only paid on that rare path.
 
+    ``repeats`` > 1 is for paths whose per-region noise rivals a single
+    delta (e.g. the BERT eager window loop, where one-shot deltas go
+    non-positive on tunnel stalls).  Two robust statistics are computed
+    and the CONSERVATIVE (larger per-call) one reported:
+
+    - min positive paired delta — each round's small/big measured
+      back-to-back, so the pair shares a session window; but a stall
+      landing in a round's SMALL region deflates that delta while
+      leaving it positive, and the min would cherry-pick it;
+    - min(t_bigs) - min(t_smalls) — stalls are one-sided additions, so
+      each min independently approaches its stall-free floor; but the
+      two floors can come from different session windows.
+
+    Each statistic's failure mode deflates per-call (inflates
+    throughput); taking the larger guards both, at worst
+    under-reporting.
+
     Returns ``(per_call_seconds, used_fallback)`` — callers surface the
     flag in their JSON so records made by the two estimators are never
     mistaken for one another.
     """
     small = max(iters // 2, 1)
-    t_small = region(small)
-    t_big = region(iters)
-    if iters > small and t_big > t_small:
-        return (t_big - t_small) / (iters - small), False
+    if iters <= small:
+        return subtract_rtt(region(iters), fallback_rt(), iters, label), True
+    deltas, t_smalls, t_bigs = [], [], []
+    for _ in range(repeats):
+        t_smalls.append(region(small))
+        t_bigs.append(region(iters))
+        deltas.append(t_bigs[-1] - t_smalls[-1])
+    pos = [d for d in deltas if d > 0]
+    cands = pos and [min(pos)] or []
+    if min(t_bigs) - min(t_smalls) > 0:
+        cands.append(min(t_bigs) - min(t_smalls))
+    if cands:
+        return max(cands) / (iters - small), False
     print(
-        f"{label}: paired slope non-positive (T_small {t_small * 1e3:.1f} "
-        f"ms, T_big {t_big * 1e3:.1f} ms) — falling back to the guarded "
-        "RTT-subtracted big region (may carry pipeline-fill overhead); "
-        "raise iters for a trustworthy slope",
+        f"{label}: paired slope non-positive in all {repeats} round(s) "
+        f"(deltas {[round(d * 1e3, 1) for d in deltas]} ms) — falling "
+        "back to the guarded RTT-subtracted best big region (may carry "
+        "pipeline-fill overhead); raise iters for a trustworthy slope",
         file=sys.stderr,
     )
-    return subtract_rtt(t_big, fallback_rt(), iters, label), True
+    return subtract_rtt(min(t_bigs), fallback_rt(), iters, label), True
 
 
 def subtract_rtt(total: float, rt: float, iters: int,

@@ -33,7 +33,7 @@ import optax
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import bluefog_tpu as bf
-from bench import measure_rtt
+from bench import measure_rtt, paired_slope
 from bluefog_tpu import topology_util
 from bluefog_tpu.models.transformer import BertEncoder
 from bluefog_tpu.ops import device_sync
@@ -137,23 +137,41 @@ def main():
     for _ in range(args.warmup):
         params, opt_state, loss = one_step(params, opt_state)
     device_sync(loss)
-    t0 = time.perf_counter()
-    for _ in range(args.iters):
-        params, opt_state, loss = one_step(params, opt_state)
-    device_sync(loss)
-    dt = (time.perf_counter() - t0) / args.iters
+
+    def region(k):
+        nonlocal params, opt_state, loss
+        t0 = time.perf_counter()
+        for _ in range(k):
+            params, opt_state, loss = one_step(params, opt_state)
+        device_sync(loss)
+        return time.perf_counter() - t0
 
     # this loop is EAGER by design (the parity window-op surface:
     # win_accumulate / win_update / associated-p / set_exposed per round,
-    # plus the jitted grad/update/apply calls), so each step pays several
-    # tunnel round-trips that no RTT *subtraction* can remove — the
-    # measured bimodality (~24k tok/s in fast-RTT sessions vs ~8k when
-    # the tunnel RTT is tens of ms) is the dispatch overhead, not the
-    # window math.  Emit the session RTT so a slow reading self-describes
-    # (the same principle as bench.py's session ceiling).
+    # plus the jitted grad/update/apply calls) — but the dispatches are
+    # ASYNC, so a region of k steps closed by one device_sync has the
+    # same `C + k*t` cost shape as the jitted benchmarks, and the shared
+    # paired-slope estimator applies: the region constant (fetch RTT +
+    # pipeline fill) cancels in the difference.  This replaced the r4
+    # single-region timing whose readings were bimodal (~24k tok/s
+    # fast-RTT sessions vs ~8k slow) — measured, most of that split was
+    # the region CONSTANT moving with the session, not the eager step
+    # cost itself.  Emit the session RTT so readings self-describe.
     # probe on a constant, not the loss: measure_rtt's _sync asserts
     # finiteness, and a diverged run should still print its JSON line
     probe = jax.block_until_ready(jnp.ones(()))
+    if os.environ.get("BERT_SCALE_DIAG"):
+        for _ in range(2):
+            for k in (2, 4, 8, 16):
+                print(f"# region({k}) = {region(k) * 1e3:8.1f} ms",
+                      file=sys.stderr)
+    # repeats=3: the eager loop's region noise (tunnel stalls of
+    # hundreds of ms) rivals a single delta, so one-shot slopes go
+    # non-positive; min-of-positive-deltas over three rounds rides out
+    # the stalls (region-scaling diagnostic: T(k) ~ 300-400 ms constant
+    # + 45-56 ms/step)
+    dt, used_fallback = paired_slope(
+        region, args.iters, "bert", lambda: measure_rtt(probe), repeats=3)
     rtt_ms = measure_rtt(probe) * 1e3
     out = {
         "metric": f"BERT-{args.preset} ({n_params/1e6:.0f}M) push-sum "
@@ -163,6 +181,8 @@ def main():
         "vs_baseline": 0.0,
         "session_rtt_ms": round(rtt_ms, 1),
         "step_ms": round(dt * 1e3, 1),
+        "estimator": "paired-slope",
+        "estimator_fallbacks": int(used_fallback),
     }
     stats = getattr(jax.local_devices()[0], "memory_stats", lambda: None)()
     if stats and stats.get("peak_bytes_in_use"):
