@@ -357,9 +357,13 @@ def main():
             from gossip_bandwidth import measure_spmd
             # 256 MB payload: the eager per-call overhead is ~10 ms on
             # slow-RTT tunnel sessions, so small payloads measure the
-            # dispatch, not the wire
+            # dispatch, not the wire.  iters=60: the paired-slope delta
+            # spans iters//2 ops, and the faster (neighbor_allreduce)
+            # phase needs ~30 x ~6 ms ≈ 0.2 s of delta to rise above
+            # region noise — at iters=10 its slope drowned and read
+            # meaningless 90-340 GB/s figures
             bw_spmd = measure_spmd(mb=256.0 if on_tpu else 4.0,
-                                   iters=10, warmup=2)
+                                   iters=60 if on_tpu else 10, warmup=2)
             # stderr: stdout carries exactly ONE JSON line (the contract);
             # the bw numbers ride in the headline line's extra keys
             print(json.dumps(bw_spmd), file=sys.stderr)

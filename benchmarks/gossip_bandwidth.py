@@ -40,6 +40,7 @@ import jax.numpy as jnp
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from bench import paired_slope
 import bluefog_tpu as bf
 from bluefog_tpu import topology_util
 from bluefog_tpu.core import basics
@@ -219,26 +220,48 @@ def main():
 
 
 def _timed_per_call(fn, iters, warmup):
-    """Per-call time with the sync round-trip subtracted.
-
-    Queued async dispatches pipeline on this platform; the expensive part
-    is the final scalar-fetch sync whose RTT varies 3.5-200 ms between
-    tunnel sessions (benchmarks/peaks.py).  Measure that RTT on the spot
-    and subtract it, so the per-call figure holds across sessions.
-    """
+    """Per-call time via the shared paired-slope estimator
+    (``bench.paired_slope``, repeats=2): the constant per-region cost —
+    fetch RTT AND pipeline fill — cancels in the region difference.  The
+    pre-r4 RTT-only subtraction left the fill share in, which at 256 MB
+    payloads (~16 ms/op true cost) inflated per-op time and
+    under-reported the wire bandwidth (docs/STATUS.md r4 estimator
+    note).  Returns (per_call_seconds, used_fallback)."""
     out = fn()  # always at least one un-timed call to trigger compile
     for _ in range(max(warmup - 1, 0)):
         out = fn()
     _sync(out)
-    t0 = time.perf_counter()
-    for _ in range(3):
-        _sync(out)
-    rt = (time.perf_counter() - t0) / 3
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn()
-    _sync(out)
-    return max((time.perf_counter() - t0 - rt), 1e-9) / iters
+
+    def region(k):
+        o = None
+        t0 = time.perf_counter()
+        for _ in range(k):
+            o = fn()
+        _sync(o)
+        return time.perf_counter() - t0
+
+    def fallback_rt():
+        t0 = time.perf_counter()
+        for _ in range(3):
+            _sync(out)
+        return (time.perf_counter() - t0) / 3
+
+    # auto-size iters so the slope's delta (~iters/2 ops) is ~1 s: the
+    # two phases differ >5x in per-op cost (a self-edge ppermute+combine
+    # collapses to nearly an HBM copy while the mailbox path does real
+    # extra passes), and a fixed iters leaves the cheap phase's delta at
+    # the scale of the tunnel's ~100 ms stalls.  Pilot mini-slope over
+    # 2-vs-8 ops estimates per-op.  TPU only: on the CPU test mesh each
+    # op fans out an 8-thread collective on a 1-core host — sizing up to
+    # hundreds of ops there trips the 40 s rendezvous timeout.
+    if jax.devices()[0].platform in ("tpu", "axon"):
+        est = (region(8) - region(2)) / 6
+        if est > 0:
+            # 2.0/est: the big region is ~2 s so the DELTA (iters/2 ops)
+            # is the targeted ~1 s, well clear of ~100 ms tunnel stalls
+            iters = max(iters, min(int(2.0 / est), 1000))
+    t, fb = paired_slope(region, iters, "gossip_bw", fallback_rt, repeats=2)
+    return max(t, 1e-9), fb
 
 
 def _loopback_plan():
@@ -329,12 +352,13 @@ def _measure_spmd_inner(ctx, topo, n, label, mb, iters, warmup):
 
     # --- win_put phase (the metric; fused put+update = one dispatch) ---
     bf.win_create(x, "gossip_bw")
-    t_put = _timed_per_call(
+    t_put, fb_put = _timed_per_call(
         lambda: bf.win_put_update(x, "gossip_bw"), iters, warmup)
     bf.win_free("gossip_bw")
 
     # --- raw neighbor_allreduce phase (the comparison point) ---
-    t_nar = _timed_per_call(lambda: bf.neighbor_allreduce(x), iters, warmup)
+    t_nar, fb_nar = _timed_per_call(
+        lambda: bf.neighbor_allreduce(x), iters, warmup)
 
     gbs_put = edges * payload_bytes / t_put / 1e9
     gbs_nar = edges * payload_bytes / t_nar / 1e9
@@ -346,6 +370,9 @@ def _measure_spmd_inner(ctx, topo, n, label, mb, iters, warmup):
         # the window path's bandwidth as a fraction of the raw collective's
         "vs_baseline": round(gbs_put / gbs_nar, 4) if gbs_nar else 0.0,
         "neighbor_allreduce_gbs": round(gbs_nar, 3),
+        # paired_slope's contract: flag phases that fell back to the
+        # fill-inflated RTT-subtraction estimator
+        "estimator_fallbacks": int(fb_put) + int(fb_nar),
     }
 
 
