@@ -1,0 +1,89 @@
+"""Unit tests for bench.paired_slope — the estimator every benchmark's
+published number now flows through (r4 second continuation).  Synthetic
+region functions with a known per-call time and per-region constant; no
+devices involved."""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
+from bench import paired_slope
+
+
+def _region_fn(per_call, constant, stalls=None):
+    """region(k) = constant + k*per_call (+ a scripted stall per call #)."""
+    calls = {"n": 0}
+    stalls = stalls or {}
+
+    def region(k):
+        i = calls["n"]
+        calls["n"] += 1
+        return constant + k * per_call + stalls.get(i, 0.0)
+
+    return region
+
+
+def test_recovers_slope_exactly_despite_constant():
+    region = _region_fn(per_call=0.05, constant=10.0)
+    t, fb = paired_slope(region, 10, "t", lambda: 0.001)
+    assert t == pytest.approx(0.05)
+    assert fb is False
+
+
+def test_constant_can_dwarf_the_signal():
+    # 300 ms constant vs 5 ms/call — the regime that broke RTT
+    # subtraction (docs/STATUS.md): the slope must still be exact
+    region = _region_fn(per_call=0.005, constant=0.3)
+    t, fb = paired_slope(region, 20, "t", lambda: 0.25)
+    assert t == pytest.approx(0.005)
+    assert fb is False
+
+
+def test_fallback_on_nonpositive_slope():
+    # big region reads FASTER than small (a stall hit the small region
+    # and nothing else) -> slope non-positive -> guarded RTT fallback
+    region = _region_fn(per_call=0.01, constant=0.1, stalls={0: 5.0})
+    t, fb = paired_slope(region, 10, "t", lambda: 0.0)
+    assert fb is True
+    # fallback = subtract_rtt(t_big, rt=0, iters) = (0.1 + 10*0.01)/10
+    assert t == pytest.approx(0.02)
+
+
+def test_repeats_survive_stall_in_small_region():
+    # A stall in round 0's SMALL region deflates that round's paired
+    # delta; the conservative two-statistic rule must NOT cherry-pick it.
+    # Rounds: (small0+stall, big0), (small1, big1), (small2, big2).
+    region = _region_fn(per_call=0.05, constant=0.2, stalls={0: 0.2})
+    t, fb = paired_slope(region, 10, "t", lambda: 0.0, repeats=3)
+    assert fb is False
+    # round 0's delta: (0.2+10*.05) - (0.2+5*.05+0.2) = 0.05 -> 0.01/call
+    # (deflated); clean rounds give exactly 0.05/call; min-min also gives
+    # 0.05.  Conservative max picks 0.05.
+    assert t == pytest.approx(0.05)
+
+
+def test_repeats_survive_stall_in_big_region():
+    # A stall in one BIG region inflates that round's delta; min over
+    # positive paired deltas ignores it, and min(t_bigs) skips the
+    # stalled big region.
+    region = _region_fn(per_call=0.05, constant=0.2, stalls={1: 0.7})
+    t, fb = paired_slope(region, 10, "t", lambda: 0.0, repeats=3)
+    assert fb is False
+    assert t == pytest.approx(0.05)
+
+
+def test_repeats_all_nonpositive_falls_back():
+    region = _region_fn(per_call=0.01, constant=0.1,
+                        stalls={0: 9.0, 2: 9.0, 4: 9.0})
+    t, fb = paired_slope(region, 10, "t", lambda: 0.0, repeats=3)
+    assert fb is True
+
+
+def test_degenerate_iters_uses_fallback():
+    region = _region_fn(per_call=0.05, constant=0.0)
+    t, fb = paired_slope(region, 1, "t", lambda: 0.0)
+    assert fb is True
+    assert t == pytest.approx(0.05)
