@@ -104,6 +104,19 @@ _ALIGNED_ENABLED = os.environ.get("BLUEFOG_FLASH_ALIGNED", "1") != "0"
 # negative; Mosaic's natural exp evidently already lowers to the cheap
 # path, so the saved multiply buys nothing on this chip.
 _EXP2_ENABLED = os.environ.get("BLUEFOG_FLASH_EXP2", "0") != "0"
+# Experiment knob: backward-only block override ("BQxBK", e.g. "512x1024").
+# The bwd kernels carry more live VMEM tiles than the forward (p, dp, ds
+# alongside q/k/v/do and the packed scalars), so their best block shape
+# need not match the forward's; this decouples them for A/B sweeps
+# without touching the API.  Empty = backward inherits the forward blocks.
+_BWD_BLOCKS = None
+if os.environ.get("BLUEFOG_FLASH_BWD_BLOCKS"):
+    _BWD_BLOCKS = tuple(
+        int(x) for x in os.environ["BLUEFOG_FLASH_BWD_BLOCKS"].split("x"))
+    if len(_BWD_BLOCKS) != 2:
+        raise ValueError(
+            "BLUEFOG_FLASH_BWD_BLOCKS must be 'BQxBK' (e.g. '512x1024'), "
+            f"got {os.environ['BLUEFOG_FLASH_BWD_BLOCKS']!r}")
 _LOG2E = math.log2(math.e)
 _LN2 = math.log(2.0)
 _MAX_UNROLL = 64  # triangular fast paths unroll at most this many k blocks
@@ -857,10 +870,12 @@ def _flash_core_bwd(scale, causal, block_q, block_k, interpret, tri_delta,
         delta = jnp.sum(o.astype(jnp.float32) * g.astype(jnp.float32),
                         axis=-1)  # [BH, Tq]
         corr = g_lse.astype(jnp.float32) - delta
+        bwd_bq, bwd_bk = (_BWD_BLOCKS if _BWD_BLOCKS is not None
+                          else (block_q, block_k))
         dq, dk, dv = _flash_bwd_pallas(
             q, k, v, lse, corr,
             q_start.astype(jnp.int32), k_start.astype(jnp.int32), g,
-            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            scale=scale, causal=causal, block_q=bwd_bq, block_k=bwd_bk,
             interpret=interpret, tri_delta=tri_delta,
         )
     return dq, dk, dv, jnp.zeros_like(q_start), jnp.zeros_like(k_start)
