@@ -185,3 +185,38 @@ def test_flash_in_llama_model():
     logits = model.apply(params, ids)
     assert logits.shape == (1, 32, 64)
     assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_bwd_block_override_numerics_identical():
+    """BLUEFOG_FLASH_BWD_BLOCKS changes only the backward kernels' tiling,
+    never the math: grads under an override must match the default
+    bit-for-bit-ish.  Subprocess because the knob is read at import."""
+    import os
+    import subprocess
+    import sys
+
+    code = """
+import jax, jax.numpy as jnp, numpy as np
+from bluefog_tpu.kernels import flash_attention
+
+def loss(q, k, v):
+    o = flash_attention(q, k, v, causal=True, block_q=32, block_k=32,
+                        interpret=True)
+    return jnp.sum(o.astype(jnp.float32) ** 2)
+
+ks = jax.random.split(jax.random.PRNGKey(0), 3)
+q, k, v = (jax.random.normal(x, (1, 64, 2, 8), jnp.float32) for x in ks)
+g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+print(",".join(f"{float(jnp.sum(x)):.6e}" for x in g))
+"""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    outs = []
+    for env_extra in ({}, {"BLUEFOG_FLASH_BWD_BLOCKS": "16x32"}):
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo,
+                   **env_extra)
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True, text=True, timeout=420,
+                              cwd=repo)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        outs.append([float(x) for x in proc.stdout.strip().split(",")])
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-5)
