@@ -222,6 +222,7 @@ class _ScannedDecoderBlock(nn.Module):
     remat: bool = False
     remat_policy: Optional[str] = None
     num_kv_heads: Optional[int] = None
+    act_constraint: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x, positions):
@@ -229,6 +230,8 @@ class _ScannedDecoderBlock(nn.Module):
                else _DecoderBlock)
         x = cls(self.num_heads, self.dff, self.dtype, self.attention_fn,
                 self.num_kv_heads)(x, positions)
+        if self.act_constraint is not None:
+            x = self.act_constraint(x)
         return x, None
 
 
@@ -309,7 +312,8 @@ def _head_matmul(x, kernel, dtype):
 
 
 def chunked_softmax_cross_entropy(hidden, kernel, labels, num_chunks,
-                                  dtype=jnp.float32):
+                                  dtype=jnp.float32, onehot_targets=False,
+                                  kernel_constraint=None):
     """Next-token cross-entropy WITHOUT materializing the full logits.
 
     The LM-head logits ``[B, T, vocab]`` in f32 are the single biggest
@@ -333,6 +337,20 @@ def chunked_softmax_cross_entropy(hidden, kernel, labels, num_chunks,
       labels: ``[B, T]`` int token ids; position t is scored against
         ``labels[:, t+1]``, the final position is masked out.
       num_chunks: number of sequence chunks; must divide T.
+      onehot_targets: extract the target logit as ``sum(logits * onehot(y))``
+        instead of ``take_along_axis`` — numerically identical, but a
+        reduction GSPMD partitions cleanly over a VOCAB-SHARDED head
+        kernel, where the gather forces it to replicate (the 8B FSDP
+        compile measured full-batch f32 activation gathers from exactly
+        this; see ``LlamaLM.spmd_vocab``).
+      kernel_constraint: applied to ``kernel`` INSIDE the scan body, once
+        per chunk.  Under FSDP this must be the SHARDING-ONLY per-read
+        marker (``fsdp_param_io_constraint(...).sharding_only`` — no
+        grad-dtype cast, or every chunk cotangent would round and the
+        scan transpose would sum in bf16) and must sit inside the body:
+        with the marker only outside, the transpose's accumulator is laid
+        out replicated — measured as the largest single temps item of the
+        8B compile (f32[4096,128k] ≈ 2.1 GB per buffer).
     """
     B, T, _ = hidden.shape
     if T % num_chunks:
@@ -352,9 +370,15 @@ def chunked_softmax_cross_entropy(hidden, kernel, labels, num_chunks,
     @jax.checkpoint
     def body(carry, xyw):
         xc, yc, wc = xyw
-        logits = _head_matmul(xc, kernel, dtype)  # [B, tc, V] — the peak
+        k = kernel if kernel_constraint is None else kernel_constraint(kernel)
+        logits = _head_matmul(xc, k, dtype)  # [B, tc, V] — the peak
         lse = jax.nn.logsumexp(logits, axis=-1)
-        tgt = jnp.take_along_axis(logits, yc[..., None], axis=-1)[..., 0]
+        if onehot_targets:
+            tgt = jnp.sum(
+                logits * jax.nn.one_hot(yc, logits.shape[-1],
+                                        dtype=logits.dtype), axis=-1)
+        else:
+            tgt = jnp.take_along_axis(logits, yc[..., None], axis=-1)[..., 0]
         # per-chunk outputs instead of a scalar carry: under shard_map a
         # plain-zeros carry init would mismatch the body's varying-axes
         # type (jax vma rules); stacked outputs inherit it automatically
@@ -401,19 +425,76 @@ class LlamaLM(nn.Module):
     num_kv_heads: Optional[int] = None  # GQA: kv heads < query heads
     head_chunks: int = 0  # >1: chunked LM loss, never materializes full logits
     head_dtype: Any = jnp.float32  # bf16: 1-pass MXU head, f32 accumulation
+    # vocab-dim-sharded deployment mode (FSDP/ZeRO with the embedding and
+    # head kernels sharded over their vocab axis): route every vocab-indexed
+    # op through matmuls/reductions — one-hot-matmul embedding and one-hot
+    # target extraction — instead of take/take_along_axis gathers.  GSPMD
+    # partitions dots and reductions over a sharded vocab axis cleanly; the
+    # gather lowering replicates the INDICES' batch axis instead, which the
+    # 8B FSDP compile measured as full-batch f32 activations on every
+    # device (~2.5 GB/layer of temps) and zero reduce-scatters.  Same
+    # params, same math (tests/test_training.py::test_llama_spmd_vocab_
+    # matches_default); the one-hot matmul is also the MXU-native lookup.
+    spmd_vocab: bool = False
+    # applied to the [B, T, d] hidden states after the embedding and after
+    # every decoder block — the standard GSPMD FSDP recipe pins the
+    # ACTIVATION layout (batch-sharded) at block boundaries, because with
+    # weights sharded on their big dims, unconstrained propagation resolves
+    # each x@W toward the locally-cheaper tensor-parallel layout (gather
+    # the small activations, keep the big weight sharded) and the whole
+    # model silently goes batch-replicated (measured on the 8B FSDP
+    # compile: ~2.5 GB/layer of replicated f32 temps, zero
+    # reduce-scatters).  See parallel/zero.py:fsdp_act_constraint.
+    act_constraint: Optional[Callable] = None
+    # applied to the one-hot embedding operand (``spmd_vocab`` path).  An
+    # FSDP caller pins it VOCAB-sharded (parallel/zero.py:
+    # fsdp_onehot_constraint) so the embedding dot partitions on its
+    # contracting dim — partial [B,T,d] products + one small reduce —
+    # instead of GSPMD's default resolution, which all-gathers the f32
+    # table (measured 2.1 GB/device on the 8B compile).
+    onehot_constraint: Optional[Callable] = None
+    # applied (via nn.map_variables in the scan path) to each layer's
+    # PARAM SLICES inside the scan body.  An FSDP caller passes
+    # "replicate over the shard axis" — an explicit gather marker on a
+    # loop-VARIANT value, which XLA cannot hoist out of the while loop.
+    # Without it GSPMD gathers the whole stacked leaf outside the loop
+    # (tests/test_hlo_contract.py::test_scan_stacked_leaves_gather_whole
+    # pinned this; at 8B that is ~11 GB of stacked bf16 FFN gathers).
+    weight_constraint: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, input_ids, positions=None, labels=None):
         B, T = input_ids.shape
         if positions is None:
             positions = jnp.arange(T)
-        x = nn.Embed(self.vocab_size, self.hidden_size, dtype=self.dtype)(input_ids)
+        embed = nn.Embed(self.vocab_size, self.hidden_size, dtype=self.dtype)
+        if self.spmd_vocab:
+            table = embed.embedding
+            if self.weight_constraint is not None:
+                table = self.weight_constraint(table)
+            oh = jax.nn.one_hot(input_ids, self.vocab_size, dtype=self.dtype)
+            if self.onehot_constraint is not None:
+                oh = self.onehot_constraint(oh)
+            x = oh @ table.astype(self.dtype)
+        else:
+            x = embed(input_ids)
+        if self.act_constraint is not None:
+            x = self.act_constraint(x)
         if self.scan_layers:
             # params gain a leading [num_layers] axis; the compiled program
             # contains ONE block body instead of num_layers copies — at 1B+
             # scale the unrolled HLO overwhelms compile services
+            body_cls = _ScannedDecoderBlock
+            if self.weight_constraint is not None:
+                wc = self.weight_constraint
+                body_cls = nn.map_variables(
+                    _ScannedDecoderBlock, "params",
+                    trans_in_fn=partial(jax.tree_util.tree_map, wc),
+                    trans_out_fn=lambda vs: vs,
+                    mutable=True, init=True,
+                )
             scan = nn.scan(
-                _ScannedDecoderBlock,
+                body_cls,
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
                 length=self.num_layers,
@@ -422,27 +503,53 @@ class LlamaLM(nn.Module):
             x, _ = scan(
                 self.num_heads, self.dff, self.dtype, self.attention_fn,
                 self.remat, self.remat_policy, self.num_kv_heads,
+                self.act_constraint,
             )(x, positions)
         else:
             # remat selection for the scan path lives in _ScannedDecoderBlock
             block_cls = (_remat_block(self.remat_policy) if self.remat
                          else _DecoderBlock)
+            if self.weight_constraint is not None:
+                block_cls = nn.map_variables(
+                    block_cls, "params",
+                    trans_in_fn=partial(jax.tree_util.tree_map,
+                                        self.weight_constraint),
+                    trans_out_fn=lambda vs: vs,
+                    mutable=True, init=True,
+                )
             for _ in range(self.num_layers):
                 x = block_cls(
                     self.num_heads, self.dff, self.dtype, self.attention_fn,
                     self.num_kv_heads,
                 )(x, positions)
+                if self.act_constraint is not None:
+                    x = self.act_constraint(x)
         x = RMSNorm(dtype=jnp.float32)(x)
         kernel = _HeadKernel(self.vocab_size, name="Dense_0")(self.hidden_size)
+        if self.weight_constraint is not None:
+            # full marker once, OUTSIDE any chunk loop: grad_dtype rounding
+            # must be one-shot on the accumulated head-kernel cotangent
+            kernel = self.weight_constraint(kernel)
         if labels is None:
             return _head_matmul(x, kernel, self.head_dtype)  # f32 logits
         if self.head_chunks > 1:
+            # sharding-only pin per chunk (keeps the scan-transpose
+            # accumulator sharded); the cast already happened above
+            wc = self.weight_constraint
             return chunked_softmax_cross_entropy(
-                x, kernel, labels, self.head_chunks, dtype=self.head_dtype
+                x, kernel, labels, self.head_chunks, dtype=self.head_dtype,
+                onehot_targets=self.spmd_vocab,
+                kernel_constraint=getattr(wc, "sharding_only", wc),
             )
         logits = _head_matmul(x, kernel, self.head_dtype)
         lse = jax.nn.logsumexp(logits[:, :-1], axis=-1)
-        tgt = jnp.take_along_axis(
-            logits[:, :-1], labels[:, 1:, None], axis=-1
-        )[..., 0]
+        if self.spmd_vocab:
+            tgt = jnp.sum(
+                logits[:, :-1] * jax.nn.one_hot(
+                    labels[:, 1:], self.vocab_size, dtype=logits.dtype),
+                axis=-1)
+        else:
+            tgt = jnp.take_along_axis(
+                logits[:, :-1], labels[:, 1:, None], axis=-1
+            )[..., 0]
         return (lse - tgt).mean()

@@ -45,10 +45,115 @@ from bluefog_tpu.training import apply_accepts_labels
 __all__ = [
     "make_zero_gossip_train_step",
     "make_fsdp_gossip_train_step",
+    "fsdp_act_constraint",
+    "fsdp_onehot_constraint",
+    "fsdp_param_io_constraint",
     "fsdp_state_struct",
     "packed_layout",
     "unpack_params",
 ]
+
+
+def fsdp_act_constraint(hier_mesh: "Mesh"):
+    """Activation constraint for models running under
+    :func:`make_fsdp_gossip_train_step` (e.g. ``LlamaLM.act_constraint``).
+
+    Pins the leading (batch) dim of every block-boundary activation to
+    ``bf_local`` — the GSPMD FSDP recipe's load-bearing half.  Weights are
+    sharded over ``bf_local`` on their largest dim, so an unconstrained
+    ``x @ W`` lets propagation choose between gathering W (FSDP, what we
+    want) and gathering x's batch (tensor-parallel-style, locally cheaper
+    because x is the smaller operand).  Without this pin the 8B compile
+    measured the latter everywhere: full-batch f32 temps ~2.5 GB/layer and
+    zero reduce-scatters.  Runs inside the machines-vmap, so the spec
+    covers the per-machine view; ``spmd_axis_name=MACHINES_AXIS`` on the
+    vmap supplies the machines dim."""
+
+    def constrain(x):
+        spec = P(LOCAL_AXIS, *([None] * (x.ndim - 1)))
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(hier_mesh, spec))
+
+    return constrain
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _marked_read(w, fwd_sh, grad_sh, grad_dtype):
+    return lax.with_sharding_constraint(w, fwd_sh)
+
+
+def _marked_read_fwd(w, fwd_sh, grad_sh, grad_dtype):
+    return lax.with_sharding_constraint(w, fwd_sh), None
+
+
+def _marked_read_bwd(fwd_sh, grad_sh, grad_dtype, _, g):
+    if grad_dtype is not None:
+        g = g.astype(grad_dtype)
+    return (lax.with_sharding_constraint(g, grad_sh),)
+
+
+_marked_read.defvjp(_marked_read_fwd, _marked_read_bwd)
+
+
+def fsdp_onehot_constraint(hier_mesh: "Mesh"):
+    """Pins the one-hot embedding operand ``[B, T, vocab]`` vocab-sharded
+    (``LlamaLM.onehot_constraint``): the embedding dot then partitions on
+    its CONTRACTING dim — each device contracts its vocab shard and the
+    [B, T, d] partials reduce — instead of GSPMD's default, which
+    all-gathers the f32 table (2.1 GB/device at 128k vocab, measured on
+    the 8B compile)."""
+
+    def constrain(oh):
+        spec = P(*([None] * (oh.ndim - 1) + [LOCAL_AXIS]))
+        return lax.with_sharding_constraint(
+            oh, NamedSharding(hier_mesh, spec))
+
+    return constrain
+
+
+def fsdp_param_io_constraint(hier_mesh: "Mesh", grad_dtype=None):
+    """Per-read FSDP marker for model weights (``LlamaLM.weight_constraint``).
+
+    Forward: re-pins the leaf (or, in a scanned model, the per-layer
+    SLICE) to its own FSDP shard spec — an identity that stops sharding
+    propagation from re-resolving the read toward a replicated layout.
+    A "gather here" (replicated-forward) marker was measured strictly
+    worse: under ``nn.scan`` GSPMD hoists the resulting gather to the
+    WHOLE stacked leaf ahead of the loop (37.5 GB of temps at
+    8B/32-layer).
+
+    Backward: the custom VJP pins the cotangent to the same shard spec AT
+    ITS PRODUCTION SITE — without it the 128k-vocab head/embedding
+    gradients accumulate replicated in f32 (measured ~2.1 GB per buffer,
+    the largest single temps item of the 8B compile) — and optionally
+    rounds it to ``grad_dtype`` (bf16 = the standard bf16-gradient
+    contract; halves gradient liveness).
+
+    The rounding must be ONE-SHOT per leaf: a scan-sliced block weight's
+    cotangent is that layer's gradient alone (no cross-layer sum), but a
+    leaf read INSIDE a loop body — the chunked LM head reads its kernel
+    once per chunk — would have each per-read cotangent rounded and then
+    summed in ``grad_dtype`` by the scan transpose.  For such sites use
+    the attached ``.sharding_only`` variant (same sharding pin, no cast)
+    inside the loop and apply the full marker once outside, so the chunk
+    cotangents accumulate in f32 and round once
+    (``LlamaLM.weight_constraint`` does this wiring)."""
+    _, local = hier_mesh.devices.shape
+
+    def _make(cast_dtype):
+        def constrain(w):
+            i = _shard_dim(w.shape, local)
+            parts = [None] * w.ndim
+            if i is not None:
+                parts[i] = LOCAL_AXIS
+            sh = NamedSharding(hier_mesh, P(*parts))
+            return _marked_read(w, sh, sh, cast_dtype)
+
+        return constrain
+
+    constrain = _make(grad_dtype)
+    constrain.sharding_only = _make(None)
+    return constrain
 
 
 class _Layout(NamedTuple):
@@ -113,8 +218,12 @@ def _make_update_rule(optimizer: str, lr: float, momentum: float,
             (mu,) = state
             if wd:
                 g = g + wd * w
-            mu = mom * mu + g
-            return -lr * mu, (mu,)
+            # accumulate in f32, store at the state's dtype: with a bf16
+            # momentum buffer (momentum_dtype=bf16, the 134M/1B bench
+            # configs' choice) this is optax's accumulator_dtype contract —
+            # halves the optimizer shard, identical math at f32 state
+            mu_f = mom * mu.astype(jnp.float32) + g
+            return -lr * mu_f, (mu_f.astype(mu.dtype),)
 
         return init, update
     if optimizer == "adamw":
@@ -126,13 +235,17 @@ def _make_update_rule(optimizer: str, lr: float, momentum: float,
         def update(g, state, w):
             mu, nu, count = state
             count = count + 1
-            mu = b1 * mu + (1 - b1) * g
-            nu = b2 * nu + (1 - b2) * g * g
+            # f32-accumulate, store at the state's dtype (same contract as
+            # sgdm above — without the cast-back, momentum_dtype=bf16
+            # state silently drifts to f32 after the first step)
+            mu_f = b1 * mu.astype(jnp.float32) + (1 - b1) * g
+            nu_f = b2 * nu.astype(jnp.float32) + (1 - b2) * g * g
             c = count.astype(jnp.float32)
-            mu_hat = mu / (1 - b1 ** c)
-            nu_hat = nu / (1 - b2 ** c)
+            mu_hat = mu_f / (1 - b1 ** c)
+            nu_hat = nu_f / (1 - b2 ** c)
             delta = -lr * (mu_hat / (jnp.sqrt(nu_hat) + eps) + wd * w)
-            return delta, (mu, nu, count)
+            return delta, (mu_f.astype(mu.dtype), nu_f.astype(nu.dtype),
+                           count)
 
         return init, update
     raise ValueError(f"optimizer must be 'sgdm' or 'adamw', got {optimizer!r}")
@@ -303,14 +416,16 @@ def _fsdp_spec(shape, local_size: int) -> P:
     return P(*parts)
 
 
-def fsdp_state_struct(leaf, hier_mesh: Mesh):
+def fsdp_state_struct(leaf, hier_mesh: Mesh, dtype=jnp.float32):
     """ShapeDtypeStruct for one master/momentum leaf with the EXACT
     sharding ``init_fn`` would give it — lets feasibility checks lower
-    the step without materializing any buffer (benchmarks/zero_8b.py)."""
+    the step without materializing any buffer (benchmarks/zero_8b.py).
+    ``dtype``: f32 for master leaves; pass the builder's ``momentum_dtype``
+    for momentum structs."""
     machines, local = hier_mesh.devices.shape
     shape = tuple(leaf.shape)
     sh = NamedSharding(hier_mesh, _fsdp_spec(shape, local))
-    return jax.ShapeDtypeStruct((machines,) + shape, jnp.float32,
+    return jax.ShapeDtypeStruct((machines,) + shape, dtype,
                                 sharding=sh)
 
 
@@ -325,6 +440,7 @@ def make_fsdp_gossip_train_step(
     optimizer: str = "sgdm",
     weight_decay: float = 0.0,
     compute_dtype=jnp.bfloat16,
+    momentum_dtype=jnp.float32,
 ):
     """FSDP-style ZeRO + gossip: per-LEAF sharding under GSPMD.
 
@@ -338,10 +454,13 @@ def make_fsdp_gossip_train_step(
 
     Decentralized semantics: each MACHINE holds its own replica (leaves
     gain a leading ``[machines]`` axis, sharded over ``bf_machines``);
-    after the local update the replicas mix with the machine topology's
-    mixing matrix — ``einsum('ms,s...->m...', W, leaf)`` over the sharded
-    machines axis, the dense-W spelling of the gossip combine (exact:
-    ``CommPlan.mixing_matrix``).
+    after the local update the replicas mix with the machine topology via
+    the shift-class plan — ``ops_spmd.neighbor_allreduce`` inside a
+    machines-manual/local-auto ``shard_map``, one ppermute per class
+    (exactly ``CommPlan.mixing_matrix`` by construction; the earlier
+    dense-W einsum spelling all-gathered every leaf's f32 shard over the
+    machines axis, which broke the 8B memory budget — see the mix-site
+    comment).
 
     ``batch``/``labels``: ``[machines, per_machine_batch, ...]``.
     """
@@ -350,9 +469,7 @@ def make_fsdp_gossip_train_step(
     _takes_labels = apply_accepts_labels(apply_fn)
     opt_init, opt_update = _make_update_rule(
         optimizer, lr, momentum, weight_decay)
-    W = None
-    if machine_plan is not None and machines > 1:
-        W = jnp.asarray(machine_plan.mixing_matrix(), jnp.float32)
+    do_mix = machine_plan is not None and machines > 1
 
     def _sharding(shape):
         return NamedSharding(hier_mesh, _fsdp_spec(shape, local))
@@ -365,7 +482,8 @@ def make_fsdp_gossip_train_step(
 
         master = jax.tree_util.tree_map(place, params)
         opt = opt_init(
-            lambda: jax.tree_util.tree_map(jnp.zeros_like, master),
+            lambda: jax.tree_util.tree_map(
+                lambda a: jnp.zeros_like(a, dtype=momentum_dtype), master),
             # per-replica, per-leaf step counter: [machines, 1, ...]
             # int32, broadcastable against its leaf
             lambda: jax.tree_util.tree_map(
@@ -408,7 +526,13 @@ def make_fsdp_gossip_train_step(
                         return loss_fn(apply_fn(pm, bm, labels=lm), lm)
                     return loss_fn(apply_fn(pm, bm), lm)
 
-                losses = jax.vmap(one)(p, batch, labels)
+                # spmd_axis_name: inside the vmap, sharding constraints
+                # (fsdp_act_constraint in the model) see the UNBATCHED
+                # per-machine shapes; the batched machines dim is pinned
+                # to MACHINES_AXIS here so the two compose into the full
+                # P(machines, local, ...) layout
+                losses = jax.vmap(one, spmd_axis_name=MACHINES_AXIS)(
+                    p, batch, labels)
                 return jnp.sum(losses), losses
 
             (_, losses), grads = jax.value_and_grad(
@@ -433,12 +557,28 @@ def make_fsdp_gossip_train_step(
             master = jax.tree_util.tree_unflatten(tdef, new_m)
             opt = tuple(jax.tree_util.tree_unflatten(tdef, slot)
                         for slot in new_o)
-            if W is not None:
+            if do_mix:
+                # gossip combine via the shift-class plan (ONE ppermute per
+                # class inside a machines-manual/local-auto shard_map), NOT
+                # the dense-W einsum: the einsum's lowering all-gathers the
+                # machines axis of every leaf's f32 shard — machines× the
+                # whole state as temps, measured 16 of the 18 GB/device
+                # that broke the 8B/32-layer budget.  ppermute keeps one
+                # in-flight shard + accumulator per leaf.  Same W by
+                # construction (machine_plan IS the matrix's source).
+                def _mix_body(t):
+                    sq = jax.tree_util.tree_map(lambda a: a[0], t)
+                    mixed = ops_spmd.neighbor_allreduce(
+                        sq, plan=machine_plan, axis_name=MACHINES_AXIS)
+                    return jax.tree_util.tree_map(lambda a: a[None], mixed)
+
+                master = jax.shard_map(
+                    _mix_body, mesh=hier_mesh,
+                    in_specs=P(MACHINES_AXIS), out_specs=P(MACHINES_AXIS),
+                    axis_names=frozenset({MACHINES_AXIS}))(master)
                 master = jax.tree_util.tree_map(
                     lambda a: lax.with_sharding_constraint(
-                        jnp.einsum("ms,s...->m...", W, a),
-                        _sharding(a.shape[1:])),
-                    master)
+                        a, _sharding(a.shape[1:])), master)
             return {"master": master, "opt": opt}, jnp.mean(losses)
 
         return jax.jit(

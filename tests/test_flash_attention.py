@@ -187,15 +187,20 @@ def test_flash_in_llama_model():
     assert bool(jnp.all(jnp.isfinite(logits)))
 
 
-def test_bwd_block_override_numerics_identical():
+def test_bwd_block_override_numerics_identical(tmp_path):
     """BLUEFOG_FLASH_BWD_BLOCKS changes only the backward kernels' tiling,
-    never the math: grads under an override must match the default
-    bit-for-bit-ish.  Subprocess because the knob is read at import."""
+    never the math.  The override legitimately reorders the f32 reduction
+    inside dK/dV accumulation, so we compare the FULL gradient arrays
+    element-wise with a float32 round-off atol — never scalar sums, whose
+    catastrophic cancellation both manufactures false positives and hides
+    real per-element errors.  Subprocess because the knob is read at
+    import."""
     import os
     import subprocess
     import sys
 
     code = """
+import sys
 import jax, jax.numpy as jnp, numpy as np
 from bluefog_tpu.kernels import flash_attention
 
@@ -207,16 +212,20 @@ def loss(q, k, v):
 ks = jax.random.split(jax.random.PRNGKey(0), 3)
 q, k, v = (jax.random.normal(x, (1, 64, 2, 8), jnp.float32) for x in ks)
 g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
-print(",".join(f"{float(jnp.sum(x)):.6e}" for x in g))
+np.savez(sys.argv[1], dq=np.asarray(g[0]), dk=np.asarray(g[1]),
+         dv=np.asarray(g[2]))
 """
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     outs = []
-    for env_extra in ({}, {"BLUEFOG_FLASH_BWD_BLOCKS": "16x32"}):
+    for i, env_extra in enumerate(({}, {"BLUEFOG_FLASH_BWD_BLOCKS": "16x32"})):
         env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo,
                    **env_extra)
-        proc = subprocess.run([sys.executable, "-c", code], env=env,
+        out = str(tmp_path / f"g{i}.npz")
+        proc = subprocess.run([sys.executable, "-c", code, out], env=env,
                               capture_output=True, text=True, timeout=420,
                               cwd=repo)
         assert proc.returncode == 0, proc.stderr[-2000:]
-        outs.append([float(x) for x in proc.stdout.strip().split(",")])
-    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-5)
+        outs.append(np.load(out))
+    for name in ("dq", "dk", "dv"):
+        np.testing.assert_allclose(outs[0][name], outs[1][name],
+                                   rtol=1e-5, atol=1e-5, err_msg=name)

@@ -297,15 +297,22 @@ def test_ep_fwd_bwd_is_three_alltoalls_one_allreduce():
     _assert_only(counts, {"all-to-all": 3, "all-reduce": 1})
 
 
-def test_scan_stacked_leaves_gather_whole_pinned():
-    """Pin scan_gather_probe's finding (its docstring demands a re-run
-    "before relying on it" after upgrades): under FSDP+GSPMD, scan-stacked
-    leaves all-gather with the FULL layer axis.  zero_8b ships unrolled
-    leaves because of this.  If this test ever fails (XLA started slicing
-    per layer), that choice must be re-evaluated — failure here is a
-    design-input change, not a bug."""
+def test_scan_stacked_leaves_never_gather_whole():
+    """Round-5 inversion of the r4 pin (which asserted scan-stacked FSDP
+    leaves all-gather with the FULL layer axis, and shipped 8B unrolled
+    because of it).  The whole-stack gathers turned out to come from two
+    now-fixed resolutions — the dense-W gossip einsum (machines-axis
+    all-gather of every leaf; replaced by the plan's ppermute combine)
+    and unconstrained activations (batch-replicated model) — so 8B now
+    SHIPS scan-stacked with the constraint set below at 15.6 GB/device
+    (benchmarks/zero_8b.py --compile).  This pin protects the new
+    design: NO all-gather may carry the full stacked layer axis, and the
+    gossip combine must ride collective-permutes."""
     from bluefog_tpu.models.transformer import LlamaLM
     from bluefog_tpu.parallel.zero import (
+        fsdp_act_constraint,
+        fsdp_onehot_constraint,
+        fsdp_param_io_constraint,
         fsdp_state_struct,
         make_fsdp_gossip_train_step,
     )
@@ -314,18 +321,20 @@ def test_scan_stacked_leaves_gather_whole_pinned():
     ctx = basics.context()
     bf.set_machine_topology(tu.RingGraph(2))
     layers = 6
-    lm = LlamaLM(vocab_size=97, hidden_size=32, num_layers=layers,
+    lm = LlamaLM(vocab_size=96, hidden_size=32, num_layers=layers,
                  num_heads=4, dff=64, remat=True, scan_layers=True,
-                 dtype=jnp.float32)
+                 dtype=jnp.float32, head_chunks=4, spmd_vocab=True,
+                 act_constraint=fsdp_act_constraint(ctx.hier_mesh),
+                 onehot_constraint=fsdp_onehot_constraint(ctx.hier_mesh),
+                 weight_constraint=fsdp_param_io_constraint(ctx.hier_mesh))
     ids0 = jnp.ones((2, 16), jnp.int32)
     p_shapes = jax.eval_shape(lm.init, jax.random.PRNGKey(0), ids0)["params"]
 
     def apply_fn(p, ids):
-        return lm.apply({"params": p}, ids)
+        return lm.apply({"params": p}, ids, labels=ids)
 
-    def loss_fn(logits, labels):
-        lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
-        return -jnp.mean(jnp.take_along_axis(lp, labels[:, 1:, None], -1))
+    def loss_fn(out, labels):
+        return out
 
     _, step_fn, _ = make_fsdp_gossip_train_step(
         apply_fn, loss_fn, ctx.hier_mesh, ctx.machine_plan,
@@ -352,9 +361,15 @@ def test_scan_stacked_leaves_gather_whole_pinned():
             if parts[:1] == [layers] or parts[1:2] == [layers]:
                 full_stack += 1
                 break
-    assert full_stack > 0, (
-        "no full-layer-stack all-gathers: XLA now slices scan-stacked "
-        "leaves per layer — re-evaluate zero_8b's unrolled-leaves choice"
+    assert full_stack == 0, (
+        f"{full_stack} all-gathers carry the full stacked layer axis — the "
+        "scan-stacked FSDP memory story (8B at 15.6 GB/device) depends on "
+        "no whole-stack gathers; check the constraint set and the ppermute "
+        "gossip combine"
+    )
+    counts = collective_counts(text)
+    assert counts.get("collective-permute", 0) >= 1, (
+        f"gossip combine lost its permutes: {dict(counts)}"
     )
 
 

@@ -356,6 +356,37 @@ def test_llama_head_chunks_matches_full():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+def test_llama_spmd_vocab_matches_default():
+    """``spmd_vocab=True`` (one-hot-matmul embedding + one-hot target
+    extraction, the vocab-sharded FSDP deployment mode) must be a pure
+    re-spelling: same params tree, same loss, same gradients as the
+    take/take_along_axis default — with and without the chunked head."""
+    from bluefog_tpu.models.transformer import LlamaLM
+
+    kw = dict(vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
+              dff=64, dtype=jnp.float32)
+    ids = jnp.asarray(
+        np.random.default_rng(1).integers(0, 96, size=(2, 16)), jnp.int32
+    )
+    for chunks in (0, 4):
+        m_ref = LlamaLM(**kw, head_chunks=chunks)
+        m_spmd = LlamaLM(**kw, head_chunks=chunks, spmd_vocab=True)
+        p = m_ref.init(jax.random.PRNGKey(0), ids)["params"]
+        p2 = m_spmd.init(jax.random.PRNGKey(0), ids)["params"]
+        assert (jax.tree_util.tree_structure(p)
+                == jax.tree_util.tree_structure(p2))
+        l_ref, g_ref = jax.value_and_grad(
+            lambda p: m_ref.apply({"params": p}, ids, labels=ids))(p)
+        l_spmd, g_spmd = jax.value_and_grad(
+            lambda p: m_spmd.apply({"params": p}, ids, labels=ids))(p)
+        np.testing.assert_allclose(np.asarray(l_spmd), np.asarray(l_ref),
+                                   rtol=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(g_ref),
+                        jax.tree_util.tree_leaves(g_spmd)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5)
+
+
 def test_lm_loss_fns_chunked_honors_distinct_labels():
     """r3 advisor: make_lm_loss_fns' chunked branch must not silently train
     on inputs-as-labels when a caller passes distinct (e.g. masked) targets.

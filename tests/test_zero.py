@@ -184,6 +184,42 @@ def test_fsdp_gossip_matches_reference(devices):
         )
 
 
+def test_fsdp_bf16_momentum_tracks_f32(devices):
+    """``momentum_dtype=bf16`` (the 8B memory config: f32-accumulate,
+    bf16-store) must keep the bf16 state buffer and track the f32-momentum
+    trajectory to bf16 resolution over several steps."""
+    from bluefog_tpu.parallel.zero import make_fsdp_gossip_train_step
+
+    ctx = _setup()
+    apply_fn, loss_fn, params = _model()
+    states, steps = [], []
+    for mdt in (jnp.float32, jnp.bfloat16):
+        init_fn, step_fn, params_of = make_fsdp_gossip_train_step(
+            apply_fn, loss_fn, ctx.hier_mesh, ctx.machine_plan,
+            learning_rate=LR, momentum=MOM, compute_dtype=jnp.float32,
+            momentum_dtype=mdt,
+        )
+        states.append(init_fn(params))
+        steps.append((step_fn, params_of))
+    (mu_bf,) = states[1]["opt"][:1]
+    assert all(l.dtype == jnp.bfloat16
+               for l in jax.tree_util.tree_leaves(mu_bf))
+    rng = np.random.default_rng(11)
+    for _ in range(4):
+        batch, labels = _data(rng)
+        fb = batch.reshape(MACHINES, LOCAL * 4, 6)
+        fl = labels.reshape(MACHINES, LOCAL * 4, 3)
+        for i, (step_fn, _) in enumerate(steps):
+            states[i], loss = step_fn(states[i], fb, fl)
+            assert np.isfinite(float(loss))
+    got_f32 = steps[0][1](states[0])
+    got_bf16 = steps[1][1](states[1])
+    for k in ("w1", "w2"):
+        np.testing.assert_allclose(
+            np.asarray(got_bf16[k], np.float32),
+            np.asarray(got_f32[k], np.float32), rtol=0, atol=2e-2)
+
+
 def test_fsdp_state_is_sharded(devices):
     from bluefog_tpu.parallel.zero import make_fsdp_gossip_train_step
 
