@@ -1,0 +1,65 @@
+"""Compiled-program inspection helpers: collective inventory and memory.
+
+The HLO perf contracts (tests/test_hlo_contract*.py) and the memory
+contracts (tests/test_memory_contract.py) both pin properties of the
+POST-PARTITIONER program — the strongest multi-chip evidence obtainable
+without multi-chip hardware, and a tripwire against GSPMD/scheduler
+regressions on jax upgrades.  The reference's analogue is asserting which
+MPI calls a collective op issues (``mpi_controller.cc`` [U]); here the
+"calls" are XLA collective opcodes and the buffer assignment.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+
+COLLECTIVES = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+# opcode sits after `=` and the (possibly tuple) result type
+_OP_RE = re.compile(r"=\s*(?:\([^)]*\)|[^\s(]+)\s+([a-z][a-z0-9\-]*)\(")
+
+
+def collective_counts(compiled_text: str) -> Counter:
+    """Count collective opcodes in ``compiled.as_text()``.
+
+    ``-start`` forms count once; ``-done`` forms are ignored (async
+    collectives appear as a start/done pair for one logical op).
+    """
+    counts = Counter()
+    for m in _OP_RE.finditer(compiled_text):
+        op = m.group(1)
+        if op.endswith("-done"):
+            continue
+        if op.endswith("-start"):
+            op = op[: -len("-start")]
+        if op in COLLECTIVES:
+            counts[op] += 1
+    return counts
+
+
+def memory_bytes(compiled) -> dict:
+    """Per-DEVICE byte accounting from XLA's buffer assignment.
+
+    The SPMD module is the per-device program, so these numbers are what
+    one chip's HBM must hold: ``arguments`` (live inputs), ``outputs``,
+    ``aliased`` (donated in/out pairs, counted once), ``temps`` (peak
+    intermediate liveness under the chosen schedule), and
+    ``live_peak_upper_bound = arguments + outputs - aliased + temps``.
+    """
+    ma = compiled.memory_analysis()
+    live = (ma.argument_size_in_bytes + ma.output_size_in_bytes
+            - ma.alias_size_in_bytes + ma.temp_size_in_bytes)
+    return {
+        "arguments": ma.argument_size_in_bytes,
+        "outputs": ma.output_size_in_bytes,
+        "aliased": ma.alias_size_in_bytes,
+        "temps": ma.temp_size_in_bytes,
+        "live_peak_upper_bound": live,
+    }

@@ -1,0 +1,136 @@
+"""Subprocess worker for the scaled HLO contracts (r4 verdict #1).
+
+One pytest process owns a fixed 8-device mesh (conftest), so contracts at
+n=16/32 — and at the pod-shaped hierarchical mesh — compile here, in a
+fresh process whose virtual device count is set by the parent
+(``tests/test_hlo_contract_scale.py``).  Prints one JSON object mapping
+contract name -> collective inventory.
+
+Run directly:  JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=32 \
+  python tests/hlo_contract_worker.py 32
+"""
+
+import functools
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bluefog_tpu as bf
+from bluefog_tpu import ops_spmd, topology_util as tu
+from bluefog_tpu.common.hlo_inspect import collective_counts
+from bluefog_tpu.core import basics
+from bluefog_tpu.core.basics import LOCAL_AXIS, MACHINES_AXIS, NODES_AXIS
+
+
+def _rank_major(spmd_fn, mesh):
+    return jax.shard_map(spmd_fn, mesh=mesh, in_specs=P(NODES_AXIS),
+                         out_specs=P(NODES_AXIS))
+
+
+def _counts(fn, *args):
+    return dict(collective_counts(
+        jax.jit(fn).lower(*args).compile().as_text()))
+
+
+def neighbor_allreduce_counts(n, topology):
+    bf.set_topology(topology)
+    ctx = basics.context()
+    x = jnp.zeros((n, 4))
+    fn = _rank_major(
+        functools.partial(ops_spmd.neighbor_allreduce, plan=ctx.plan,
+                          axis_name=NODES_AXIS), ctx.mesh)
+    return _counts(fn, x)
+
+
+def hierarchical_counts(n, machines, machine_topology):
+    bf.shutdown()
+    bf.init(local_size=n // machines)
+    bf.set_machine_topology(machine_topology)
+    ctx = basics.context()
+    x = jnp.zeros((n, 4))
+
+    def spmd(t):
+        return ops_spmd.hierarchical_neighbor_allreduce(
+            t, machine_plan=ctx.machine_plan, machines_axis=MACHINES_AXIS,
+            local_axis=LOCAL_AXIS)
+
+    fn = jax.shard_map(spmd, mesh=ctx.hier_mesh,
+                       in_specs=P((MACHINES_AXIS, LOCAL_AXIS)),
+                       out_specs=P((MACHINES_AXIS, LOCAL_AXIS)))
+    return _counts(fn, x)
+
+
+def gradient_tracking_counts(n):
+    from bluefog_tpu import algorithms
+
+    bf.shutdown()
+    bf.init()
+    bf.set_topology(tu.ExponentialTwoGraph(n))
+    ctx = basics.context()
+    tx = algorithms.gradient_tracking_spmd(0.1, ctx.plan)
+
+    def spmd(p, g):
+        state = tx.init(p)
+        updates, _ = tx.update(g, state, p)
+        return updates
+
+    fn = jax.shard_map(spmd, mesh=ctx.mesh, in_specs=(P(NODES_AXIS),) * 2,
+                       out_specs=P(NODES_AXIS))
+    x = jnp.zeros((n, 4))
+    return _counts(fn, x, x)
+
+
+def window_exchange_counts(n):
+    from bluefog_tpu.windows import _build_exchange
+
+    bf.shutdown()
+    bf.init()
+    bf.set_topology(tu.ExponentialTwoGraph(n))
+    ctx = basics.context()
+    plan = ctx.plan
+    nclasses = len(plan.classes)
+    maxd = plan.max_in_degree
+    x = jnp.zeros((n, 4), jnp.float32)
+    mail = jnp.zeros((n, maxd, 4), jnp.float32)
+    ver = jnp.zeros((n, maxd), jnp.int32)
+    p_self = jnp.ones((n,), jnp.float32)
+    p_mail = jnp.ones((n, maxd), jnp.float32)
+    scales = jnp.ones((nclasses, n), jnp.float32)
+    active = jnp.ones((nclasses, n), jnp.float32)
+    f = _build_exchange(plan, accumulate=False, with_p=False, donate=False)
+    text = f.lower(x, mail, ver, p_self, p_mail, scales,
+                   active).compile().as_text()
+    return {"n_classes": nclasses, **dict(collective_counts(text))}
+
+
+def main():
+    n = int(sys.argv[1])
+    assert len(jax.devices()) == n, (len(jax.devices()), n)
+    bf.init()
+    out = {
+        "n": n,
+        "exp2": neighbor_allreduce_counts(n, tu.ExponentialTwoGraph(n)),
+        "ring": neighbor_allreduce_counts(n, tu.RingGraph(n)),
+        "gradient_tracking_exp2": gradient_tracking_counts(n),
+        "window_exchange_exp2": window_exchange_counts(n),
+    }
+    if n == 32:
+        # the pod shape: 8 machines x 4 local chips (v4-32-class)
+        out["hier_8x4_exp2"] = hierarchical_counts(
+            32, 8, tu.ExponentialTwoGraph(8))
+        out["hier_8x4_ring"] = hierarchical_counts(32, 8, tu.RingGraph(8))
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

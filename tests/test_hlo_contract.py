@@ -29,18 +29,9 @@ from bluefog_tpu import ops_spmd, topology_util as tu
 from bluefog_tpu.core import basics
 from bluefog_tpu.core.basics import LOCAL_AXIS, MACHINES_AXIS, NODES_AXIS
 
+from bluefog_tpu.common.hlo_inspect import COLLECTIVES, collective_counts
+
 SIZE = 8
-
-COLLECTIVES = (
-    "all-reduce",
-    "all-gather",
-    "reduce-scatter",
-    "collective-permute",
-    "all-to-all",
-)
-
-# opcode sits after `=` and the (possibly tuple) result type
-_OP_RE = re.compile(r"=\s*(?:\([^)]*\)|[^\s(]+)\s+([a-z][a-z0-9\-]*)\(")
 
 
 @pytest.fixture(autouse=True)
@@ -48,19 +39,6 @@ def fresh_context(devices):
     bf.init(local_size=2)
     yield
     bf.shutdown()
-
-
-def collective_counts(compiled_text: str) -> Counter:
-    counts = Counter()
-    for m in _OP_RE.finditer(compiled_text):
-        op = m.group(1)
-        if op.endswith("-done"):
-            continue
-        if op.endswith("-start"):
-            op = op[: -len("-start")]
-        if op in COLLECTIVES:
-            counts[op] += 1
-    return counts
 
 
 def _compiled_text(fn, *args):

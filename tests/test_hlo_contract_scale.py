@@ -1,0 +1,80 @@
+"""Scaled HLO perf contracts: n=16/32 and the pod-shaped hierarchical mesh
+(r4 verdict next-round #1a).
+
+``test_hlo_contract.py`` pins every path's collective inventory at the
+in-process n=8 mesh; these pin the SCALING LAW — one collective-permute
+per shift class, so exp2@n must compile to exactly log2(n) permutes and
+zero all-gathers at every n, and the hierarchical path at the v4-32-class
+pod shape (8 machines x 4 local) must stay one local all-reduce plus
+machine-ring/exp2 permutes.  An O(deg)->O(n) regression that only
+manifests past n=8 (e.g. a GSPMD fallback on larger replica groups) is
+exactly what these would catch.
+
+Subprocess per n because one process owns one XLA device count; the
+worker (``hlo_contract_worker.py``) prints the inventories as JSON.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_worker(n):
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=REPO,
+        XLA_FLAGS=f"--xla_force_host_platform_device_count={n}",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tests",
+                                      "hlo_contract_worker.py"), str(n)],
+        env=env, capture_output=True, text=True, timeout=540, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+@pytest.fixture(scope="module")
+def inventories():
+    return {n: _run_worker(n) for n in (16, 32)}
+
+
+def test_exp2_permutes_scale_logarithmically(inventories):
+    assert inventories[16]["exp2"] == {"collective-permute": 4}
+    assert inventories[32]["exp2"] == {"collective-permute": 5}
+
+
+def test_ring_stays_two_permutes(inventories):
+    for n in (16, 32):
+        assert inventories[n]["ring"] == {"collective-permute": 2}
+
+
+def test_gradient_tracking_matches_plain_gossip_at_scale(inventories):
+    """Exactness must stay collective-free at every n: GT's fused x+y round
+    equals plain exp2 gossip's inventory."""
+    assert inventories[16]["gradient_tracking_exp2"] == {
+        "collective-permute": 4}
+    assert inventories[32]["gradient_tracking_exp2"] == {
+        "collective-permute": 5}
+
+
+def test_window_exchange_one_permute_per_class_at_scale(inventories):
+    for n in (16, 32):
+        inv = dict(inventories[n]["window_exchange_exp2"])
+        nclasses = inv.pop("n_classes")
+        assert inv == {"collective-permute": nclasses}
+
+
+def test_hierarchical_pod_shape(inventories):
+    """8 machines x 4 local (v4-32-class pod): ONE local all-reduce plus
+    machine-axis permutes only — exp2@8 machines = 3 classes, ring = 2;
+    an all-gather or a second all-reduce would break the DCN story."""
+    assert inventories[32]["hier_8x4_exp2"] == {
+        "all-reduce": 1, "collective-permute": 3}
+    assert inventories[32]["hier_8x4_ring"] == {
+        "all-reduce": 1, "collective-permute": 2}
