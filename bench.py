@@ -195,6 +195,34 @@ def time_steps(step_fn, params, batch_stats, opt_state, batch, labels, warmup,
     return paired_slope(region, iters, "resnet", lambda: measure_rtt(loss))
 
 
+def robust_min(ts, label=""):
+    """Throughput-defining minimum, guarded on the LOW side (r4 advisor):
+    a tunnel stall landing in a pass's SMALL region deflates that pass's
+    paired-slope per-call, and a plain ``min`` would preferentially
+    select the deflated pass, inflating the headline.  If the smallest
+    time is not REPRODUCED by the second smallest within 3% (the same
+    bar the adaptive top-2 loop drives toward), the second smallest is
+    reported instead — at worst conservative."""
+    s = sorted(ts)
+    if len(s) >= 2 and (s[1] - s[0]) / s[0] > 0.03:
+        print(
+            f"robust-min{' (' + label + ')' if label else ''}: smallest "
+            f"pass {s[0] * 1e3:.1f} ms not reproduced by 2nd "
+            f"{s[1] * 1e3:.1f} ms within 3% — reporting the 2nd "
+            "(guards stall-deflated slopes)",
+            file=sys.stderr,
+        )
+        return s[1]
+    return s[0]
+
+
+def throughput_range(times, scale):
+    """[lo, hi] throughput across passes for the JSON ``range`` field
+    (r4 verdict #7: per-headline uncertainty in the contract, not in
+    STATUS prose)."""
+    return [round(scale / max(times), 2), round(scale / min(times), 2)]
+
+
 def main():
     platform = jax.devices()[0].platform
     n = len(jax.devices())
@@ -264,12 +292,64 @@ def main():
         batch, labels, params, batch_stats, steps_per_call=spc,
     )
     ar_times = [timed_pass(step_ar, os_ar, warmup)]
+
+    # Session-ceiling phase: bare XLA fwd+bwd per step — no optimizer, no
+    # gossip, no metrics — slope-timed in the SAME interleaved passes as
+    # the headline (r4 verdict Weak #3: a ceiling measured in its own
+    # later session window could be outrun by the headline by 1-12%;
+    # interleaving makes ratio_to_session_ceiling <= ~1 by construction
+    # in a steady session).  value/ceiling says how close the full step
+    # sits to what this session's tunnel+chip can do at all; a slow
+    # session is then self-describing in the JSON.
+    bare_times = []
+    bare_pass = None
+    try:
+        @jax.jit
+        def bare_step(p, bs, x, y):
+            def loss_of(p_):
+                logits, _ = model.apply(
+                    {"params": p_, "batch_stats": bs}, x, train=True,
+                    mutable=["batch_stats"])
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y).mean()
+            return jax.value_and_grad(loss_of)(p)
+
+        p0 = jax.tree_util.tree_map(lambda a: a[0], params)
+        bs0 = jax.tree_util.tree_map(lambda a: a[0], batch_stats)
+        x0b = batch[(0, 0) if spc > 1 else (0,)]
+        y0b = labels[(0, 0) if spc > 1 else (0,)]
+        loss0, _ = bare_step(p0, bs0, x0b, y0b)
+        _sync(loss0)
+
+        def bare_region(k):
+            t0 = time.perf_counter()
+            ls = None
+            for _ in range(k):
+                ls, _ = bare_step(p0, bs0, x0b, y0b)
+            _sync(ls)
+            return time.perf_counter() - t0
+
+        def bare_pass():
+            nonlocal fallback_passes
+            # same shared paired-slope estimator as time_steps, so
+            # value/ceiling compares like with like
+            t, used_fb = paired_slope(
+                bare_region, iters, "bare", lambda: measure_rtt(loss0))
+            fallback_passes += int(used_fb)
+            return t
+
+        bare_times.append(bare_pass())
+    except Exception as e:  # noqa: BLE001
+        bare_pass = None
+        print(f"session-ceiling phase failed: {e!r}", file=sys.stderr)
+
     # ADAPTIVE interleaved passes (r3 verdict next-round #2, extending the
     # r2 min-of-4): keep adding passes until the throughput-defining MIN is
     # REPRODUCED — the two smallest times per phase agree within 3% — or
     # the pass cap / wall budget runs out.  A slow tunnel session cannot
     # make the min lie high, only fail to reproduce it, and that failure
-    # is what spread_pct then reports.
+    # is what spread_pct then reports.  The bare-ceiling pass rides the
+    # same rotation so every phase shares the same session windows.
     def min2_spread(ts):
         # single-pass degenerate case reports 0.0 (pre-adaptive semantics;
         # float('inf') would print non-RFC "Infinity" in the JSON line)
@@ -285,64 +365,31 @@ def main():
             break
         dec_times.append(timed_pass(step_dec, os_dec, 1))
         ar_times.append(timed_pass(step_ar, os_ar, 1))
-    t_dec, t_ar = min(dec_times), min(ar_times)
+        if bare_pass is not None:
+            try:
+                bare_times.append(bare_pass())
+            except Exception as e:  # noqa: BLE001
+                # ceiling stays best-effort: a transient tunnel error here
+                # must not cost the already-measured headline
+                bare_pass = None
+                print(f"session-ceiling pass failed: {e!r}", file=sys.stderr)
+    t_dec = robust_min(dec_times, "dec")
+    t_ar = robust_min(ar_times, "allreduce")
     # spread_pct: reproducibility of the min (top-2 agreement, what the
     # adaptive loop drives < 3); spread_all_pct: the legacy full range
     spread_pct = max(min2_spread(dec_times), min2_spread(ar_times))
     spread_all_pct = max(
-        (max(dec_times) - t_dec) / t_dec,
-        (max(ar_times) - t_ar) / t_ar,
+        (max(dec_times) - min(dec_times)) / min(dec_times),
+        (max(ar_times) - min(ar_times)) / min(ar_times),
     ) * 100
 
     imgs_per_sec_chip = per_rank_batch * spc / t_dec  # per-rank == per-chip
 
-    # Session ceiling (r3 STATUS decomposition, now emitted every run):
-    # bare XLA fwd+bwd per step — no optimizer, no gossip, no metrics —
-    # slope-timed in THIS session.  value/ceiling says how close the full
-    # step sits to what this session's tunnel+chip can do at all; a slow
-    # session is then self-describing in the JSON.
     ceiling_img_s = ratio_to_ceiling = None
-    try:
-        import functools as _ft
-
-        @jax.jit
-        def bare_step(p, bs, x, y):
-            def loss_of(p_):
-                logits, _ = model.apply(
-                    {"params": p_, "batch_stats": bs}, x, train=True,
-                    mutable=["batch_stats"])
-                return optax.softmax_cross_entropy_with_integer_labels(
-                    logits, y).mean()
-            return jax.value_and_grad(loss_of)(p)
-
-        p0 = jax.tree_util.tree_map(lambda a: a[0], params)
-        bs0 = jax.tree_util.tree_map(lambda a: a[0], batch_stats)
-        x0b = batch[(0, 0) if spc > 1 else (0,)]
-        y0b = labels[(0, 0) if spc > 1 else (0,)]
-        loss, grads = bare_step(p0, bs0, x0b, y0b)
-        _sync(loss)
-
-        def bare_region(k):
-            t0 = time.perf_counter()
-            ls = None
-            for _ in range(k):
-                ls, _ = bare_step(p0, bs0, x0b, y0b)
-            _sync(ls)
-            return time.perf_counter() - t0
-
-        # same shared paired-slope estimator as time_steps, so
-        # value/ceiling compares like with like
-        bare_times = []
-        for _ in range(3):
-            t_bare_i, used_fb = paired_slope(
-                bare_region, iters, "bare", lambda: measure_rtt(loss))
-            fallback_passes += int(used_fb)
-            bare_times.append(t_bare_i)
-        t_bare = min(bare_times)
+    if bare_times:
+        t_bare = robust_min(bare_times, "bare")
         ceiling_img_s = per_rank_batch / t_bare
         ratio_to_ceiling = imgs_per_sec_chip / ceiling_img_s
-    except Exception as e:  # noqa: BLE001
-        print(f"session-ceiling phase failed: {e!r}", file=sys.stderr)
     ratio = t_ar / t_dec  # >1 means gossip step is faster than allreduce
 
     # Second BASELINE.json tracked metric: win_put gossip bandwidth —
@@ -411,12 +458,24 @@ def main():
         # legacy full min-max range across all passes
         "spread_all_pct": round(spread_all_pct, 2),
         "passes": len(dec_times),
+        # per-headline uncertainty IN the contract (r4 verdict #7):
+        # throughput across all passes, worst to best ("passes" above is
+        # this headline's n_runs)
+        "range": throughput_range(dec_times, per_rank_batch * spc),
+        # single-chip note: on 1 chip the exp2 plan has no neighbors, so
+        # gossip and allreduce compile to the same program and
+        # vs_baseline is ~1 BY CONSTRUCTION — the multi-chip gossip
+        # advantage is evidenced by the HLO contracts
+        # (tests/test_hlo_contract*.py), not this field
+        "vs_baseline_note": ("single-chip: ratio ~1 by construction"
+                             if n == 1 else "multi-chip measured ratio"),
     }
     if ceiling_img_s is not None:
-        # this session's bare-XLA fwd+bwd ceiling and how close the full
-        # framework step sits to it (r3 STATUS: framework adds ~11%;
-        # ratio >= ~0.9 means a low headline is a slow session, not a
-        # code regression)
+        # this session's bare-XLA fwd+bwd ceiling, slope-timed in the
+        # SAME interleaved passes as the headline (ratio <= ~1 in a
+        # steady session by construction; r3 STATUS: framework adds
+        # ~11%; ratio >= ~0.9 means a low headline is a slow session,
+        # not a code regression)
         headline["session_ceiling_img_s"] = round(ceiling_img_s, 2)
         headline["ratio_to_session_ceiling"] = round(ratio_to_ceiling, 4)
     if bw_spmd is not None:

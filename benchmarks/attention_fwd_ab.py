@@ -164,7 +164,18 @@ def main():
             fallbacks.append(impl)
             per_call[impl] = min(big[impl]) / (2 * n_delta)
         else:
-            per_call[impl] = min(pos) / n_delta
+            # same two-statistic rule as bench.paired_slope (r4 advisor:
+            # min(pos) alone cherry-picks a stall-deflated delta — a
+            # stall in one repeat's SMALL region leaves its delta
+            # positive but too small, silently inflating the ratio).
+            # Both statistics' failure modes deflate per-call; take the
+            # conservative larger.
+            smalls = [b_ - d_ for b_, d_ in zip(big[impl], deltas[impl])]
+            cands = [min(pos)]
+            floor_delta = min(big[impl]) - min(smalls)
+            if floor_delta > 0:
+                cands.append(floor_delta)
+            per_call[impl] = max(cands) / n_delta
     tp, tx = per_call["pallas"], per_call["xla"]
     flops = 2 * 2 * b * h * t * t * d * 0.5  # qk+pv matmuls, causal half
     print(json.dumps({

@@ -8,6 +8,25 @@ gossip round (win_accumulate to the ring successor, debiased win_update)
 §2.3 "asynchronous decentralized DP".  Prints ONE JSON line with
 tokens/sec/chip and peak HBM use.
 
+Two timing modes, BOTH in the JSON (r4 verdict #3 — the eager number's
+78-110k tok/s interval was the one headline the paired-slope estimator
+could not tighten):
+
+- ``device`` (the headline): k full rounds — grad, Adam, pack, the ring
+  exchange (the same ``windows._exchange_body`` program the eager ops
+  compile), weighted combine, debias, reset — run as ONE dispatch via
+  ``lax.fori_loop`` with a DYNAMIC trip count (one compile serves every
+  k).  A region of one dispatch closed by one sync has exactly the
+  ``C + k*t`` shape ``paired_slope`` needs, so the tunnel constant
+  cancels instead of smearing 42% across sessions.  Numerics proven
+  identical to the eager loop (``build_flows`` equivalence; asserted
+  at startup here and pinned on the CPU mesh by
+  tests/test_bench_estimator.py::test_bert_device_side_matches_eager).
+- ``eager`` (the API-faithful secondary): the per-round win_accumulate /
+  win_update / associated-p / set_exposed surface, one host dispatch
+  chain per round; its conservative repeats-mode estimate is CALIBRATED
+  against the device number in the JSON (``eager_over_device``).
+
 Run (TPU):      python benchmarks/bert_pushsum.py
 Run (CPU mesh): JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
                     python benchmarks/bert_pushsum.py --preset tiny
@@ -33,8 +52,10 @@ import optax
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import bluefog_tpu as bf
-from bench import measure_rtt, paired_slope
-from bluefog_tpu import topology_util
+from bench import measure_rtt, paired_slope, robust_min, throughput_range
+from bluefog_tpu import topology_util, windows
+from bluefog_tpu.core import basics
+from bluefog_tpu.core.basics import NODES_AXIS
 from bluefog_tpu.models.transformer import BertEncoder
 from bluefog_tpu.ops import device_sync
 
@@ -47,20 +68,31 @@ PRESETS = {
 }
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
-    ap.add_argument("--preset", default="base" if on_tpu else "tiny",
-                    choices=sorted(PRESETS))
-    ap.add_argument("--iters", type=int, default=10 if on_tpu else 3)
-    ap.add_argument("--warmup", type=int, default=2)
-    args = ap.parse_args()
-    cfg = PRESETS[args.preset]
+def build_flows(cfg, n, seed=0):
+    """Model + data + BOTH timing flows for the push-sum fine-tune round.
 
-    bf.init()
-    n = bf.size()
+    Returns ``(state, eager_step, device_rounds, meta)``:
+
+    - ``state = (params, opt_state)`` rank-major (identical start for both
+      flows; the eager flow keeps its window/mailbox in the bf registry,
+      the device flow carries them in ``device_rounds``'s own state);
+    - ``eager_step(params, opt_state) -> (params, opt_state, loss)`` —
+      the API-faithful per-round surface (win_accumulate / win_update /
+      associated-p / set_exposed);
+    - ``device_rounds(dstate, k) -> (dstate, loss)`` — ONE jitted
+      dispatch running k full rounds via ``lax.fori_loop`` with a
+      DYNAMIC trip count; ``dstate = device_init(params, opt_state)``.
+      Same math (test_bench_estimator pins eager == device on the CPU
+      mesh), expressed with the same ``windows._exchange_body`` program
+      and ``windows._class_scales`` weights the eager ops compile.
+    """
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
     bf.set_topology(topology_util.RingGraph(n, connect_style=1))
     bf.turn_on_win_ops_with_associated_p()
+    ctx = basics.context()
+    plan = ctx.plan
 
     model = BertEncoder(
         vocab_size=cfg["vocab"], hidden_size=cfg["hidden"],
@@ -68,7 +100,7 @@ def main():
         max_len=cfg["seq"], num_classes=2, dtype=jnp.bfloat16,
     )
     B, T = cfg["batch"], cfg["seq"]
-    rng = np.random.default_rng(0)
+    rng = np.random.default_rng(seed)
     ids = jnp.asarray(rng.integers(0, cfg["vocab"], size=(n, B, T)), jnp.int32)
     labels = jnp.asarray(rng.integers(0, 2, size=(n, B)), jnp.int32)
 
@@ -117,7 +149,7 @@ def main():
     dst = [{(r + 1) % n: 0.5} for r in range(n)]
     ones_prev = [{(r - 1) % n: 1.0} for r in range(n)]
 
-    def one_step(params, opt_state):
+    def eager_step(params, opt_state):
         loss, grads = grad_fn(params, ids, labels)
         updates, opt_state = upd_fn(grads, opt_state, params)
         params = apply_fn(params, updates)
@@ -133,57 +165,195 @@ def main():
         params = jax.tree_util.tree_unflatten(treedef, unpack(merged))
         return params, opt_state, loss
 
-    loss = None
-    for _ in range(args.warmup):
-        params, opt_state, loss = one_step(params, opt_state)
-    device_sync(loss)
+    # --- device-side flow: the same round under lax.fori_loop ------------
+    maxd = max(plan.max_in_degree, 1)
+    D = int(sum(sizes))
+    wdt = jnp.float32
+    send_scales, send_active = windows._class_scales(plan, dst, side="send")
+    send_scales = jnp.asarray(send_scales)
+    send_active = jnp.asarray(send_active)
 
-    def region(k):
-        nonlocal params, opt_state, loss
+    def device_init(params, opt_state):
+        return dict(
+            params=params, opt=opt_state,
+            mail=jnp.zeros((n, maxd, D), wdt),
+            ver=jnp.zeros((n, maxd), jnp.int32),
+            p_self=jnp.ones((n,), jnp.float32),
+            p_mail=jnp.zeros((n, maxd), jnp.float32),
+        )
+
+    def spmd_rounds(params, opt_state, mail, ver, p_self, p_mail,
+                    ids_r, labels_r, k):
+        # per-rank views: rank-major leaves arrive with a leading 1
+        idx = lax.axis_index(NODES_AXIS)
+        strip = lambda t: jax.tree_util.tree_map(
+            lambda a: a[0] if getattr(a, "ndim", 0) >= 1 else a, t)
+        expand_like = lambda new, old: jax.tree_util.tree_map(
+            lambda a, o: a[None] if getattr(o, "ndim", 0) >= 1 else a,
+            new, old)
+
+        def body(c):
+            p1, os1, mail, ver, ps, pm, _ = c
+            p = strip(p1)
+            os_ = strip(os1)
+            loss, grads = jax.value_and_grad(rank_loss)(
+                p, ids_r[0], labels_r[0])
+            updates, os_ = opt.update(grads, os_, p)
+            p = optax.apply_updates(p, updates)
+            leaves = jax.tree_util.tree_leaves(p)
+            packed = jnp.concatenate(
+                [a.reshape(-1).astype(wdt) for a in leaves])
+            # the ring accumulate: the SAME per-rank exchange program the
+            # eager win_accumulate compiles (windows._exchange_body)
+            mail0, ver0, pm0 = windows._exchange_body(
+                plan, True, True, packed[None], mail[0], ver[0], ps,
+                pm[0], send_scales_r, send_active_r, idx)
+            # win_update(self 0.5, neighbor 1.0, reset) + debias + restart
+            merged = (0.5 * packed + mail0.sum(axis=0))
+            p_new = 0.5 * ps[0] + pm0.sum()
+            merged = merged / p_new
+            out, off = [], 0
+            for leaf, sz in zip(leaves, sizes):
+                out.append(
+                    merged[off:off + sz].reshape(leaf.shape).astype(leaf.dtype))
+                off += sz
+            p = jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(p), out)
+            return (expand_like(p, p1), expand_like(os_, os1),
+                    jnp.zeros_like(mail), ver0[None],
+                    jnp.ones_like(ps), jnp.zeros_like(pm), loss[None])
+
+        send_scales_r = send_scales[:, idx][:, None]
+        send_active_r = send_active[:, idx][:, None]
+        init = (params, opt_state, mail, ver, p_self, p_mail,
+                jnp.zeros((1,), jnp.float32))
+        out = lax.fori_loop(0, k, lambda i, c: body(c), init)
+        return out
+
+    rank_spec = lambda t: jax.tree_util.tree_map(
+        lambda a: P(NODES_AXIS) if getattr(a, "ndim", 0) >= 1 else P(), t)
+    in_specs = (rank_spec(params), rank_spec(opt_state), P(NODES_AXIS),
+                P(NODES_AXIS), P(NODES_AXIS), P(NODES_AXIS), P(NODES_AXIS),
+                P(NODES_AXIS), P())
+    out_specs = (rank_spec(params), rank_spec(opt_state), P(NODES_AXIS),
+                 P(NODES_AXIS), P(NODES_AXIS), P(NODES_AXIS), P(NODES_AXIS))
+    sm = jax.jit(jax.shard_map(
+        spmd_rounds, mesh=ctx.mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False))
+
+    def device_rounds(dstate, k):
+        p, os_, mail, ver, ps, pm, loss = sm(
+            dstate["params"], dstate["opt"], dstate["mail"], dstate["ver"],
+            dstate["p_self"], dstate["p_mail"], ids, labels,
+            jnp.asarray(k, jnp.int32))
+        return dict(params=p, opt=os_, mail=mail, ver=ver, p_self=ps,
+                    p_mail=pm), loss
+
+    meta = dict(n_params=n_params, B=B, T=T, device_init=device_init)
+    return (params, opt_state), eager_step, device_rounds, meta
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    ap.add_argument("--preset", default="base" if on_tpu else "tiny",
+                    choices=sorted(PRESETS))
+    ap.add_argument("--iters", type=int, default=10 if on_tpu else 3)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--passes", type=int, default=3 if on_tpu else 1,
+                    help="device-mode paired-slope passes (value = "
+                    "bench.robust_min; JSON carries the range)")
+    ap.add_argument("--skip-eager", action="store_true",
+                    help="device headline only (halves the wall time; the "
+                    "eager calibration columns are omitted)")
+    args = ap.parse_args()
+    cfg = PRESETS[args.preset]
+
+    bf.init()
+    n = bf.size()
+    (params, opt_state), eager_step, device_rounds, meta = build_flows(cfg, n)
+    B, T, n_params = meta["B"], meta["T"], meta["n_params"]
+
+    # --- startup equivalence: one device-side round == one eager round ---
+    # (the CPU-mesh test pins this at tolerance; here a cheap tripwire
+    # that the two flows still implement the same math on this build)
+    dstate, dloss = device_rounds(meta["device_init"](params, opt_state), 1)
+    e_params, e_opt, eloss = eager_step(params, opt_state)
+    l0 = jax.tree_util.tree_leaves(dstate["params"])[0]
+    l1 = jax.tree_util.tree_leaves(e_params)[0]
+    drift = float(jnp.max(jnp.abs(l0.astype(jnp.float32)
+                                  - l1.astype(jnp.float32))))
+    assert drift < 5e-2, f"device/eager flows diverged: max|dp|={drift}"
+
+    probe = jax.block_until_ready(jnp.ones(()))
+
+    # --- device-side headline: one dispatch of k rounds -> C + k*t ------
+    dstate = meta["device_init"](e_params, e_opt)
+    loss_box = [dloss]
+
+    def device_region(k):
         t0 = time.perf_counter()
-        for _ in range(k):
-            params, opt_state, loss = one_step(params, opt_state)
-        device_sync(loss)
+        st, loss_box[0] = device_rounds(dstate, k)
+        device_sync(loss_box[0])
         return time.perf_counter() - t0
 
-    # this loop is EAGER by design (the parity window-op surface:
-    # win_accumulate / win_update / associated-p / set_exposed per round,
-    # plus the jitted grad/update/apply calls) — but the dispatches are
-    # ASYNC, so a region of k steps closed by one device_sync has the
-    # same `C + k*t` cost shape as the jitted benchmarks, and the shared
-    # paired-slope estimator applies: the region constant (fetch RTT +
-    # pipeline fill) cancels in the difference.  This replaced the r4
-    # single-region timing whose readings were bimodal (~24k tok/s
-    # fast-RTT sessions vs ~8k slow) — measured, most of that split was
-    # the region CONSTANT moving with the session, not the eager step
-    # cost itself.  Emit the session RTT so readings self-describe.
-    # probe on a constant, not the loss: measure_rtt's _sync asserts
-    # finiteness, and a diverged run should still print its JSON line
-    probe = jax.block_until_ready(jnp.ones(()))
-    if os.environ.get("BERT_SCALE_DIAG"):
-        for _ in range(2):
-            for k in (2, 4, 8, 16):
-                print(f"# region({k}) = {region(k) * 1e3:8.1f} ms",
-                      file=sys.stderr)
-    # repeats=3: the eager loop's region noise (tunnel stalls of
-    # hundreds of ms) rivals a single delta, so one-shot slopes go
-    # non-positive; min-of-positive-deltas over three rounds rides out
-    # the stalls (region-scaling diagnostic: T(k) ~ 300-400 ms constant
-    # + 45-56 ms/step)
-    dt, used_fallback = paired_slope(
-        region, args.iters, "bert", lambda: measure_rtt(probe), repeats=3)
-    rtt_ms = measure_rtt(probe) * 1e3
+    dev_times, dev_fb = [], 0
+    for _ in range(args.passes):
+        t, fb = paired_slope(device_region, args.iters, "bert-device",
+                             lambda: measure_rtt(probe))
+        dev_times.append(t)
+        dev_fb += int(fb)
+    dt_dev = robust_min(dev_times, "bert-device")
+
     out = {
         "metric": f"BERT-{args.preset} ({n_params/1e6:.0f}M) push-sum "
                   f"fine-tune tokens/sec/chip (directed ring, S={T})",
-        "value": round(B * T / dt, 1),
+        "value": round(B * T / dt_dev, 1),
         "unit": "tok/s/chip",
         "vs_baseline": 0.0,
-        "session_rtt_ms": round(rtt_ms, 1),
-        "step_ms": round(dt * 1e3, 1),
+        "step_ms": round(dt_dev * 1e3, 1),
+        # the k-rounds-in-one-dispatch program: the same math as the
+        # eager window-op surface (equivalence asserted above and pinned
+        # by tests), timed through a region with the exact C + k*t shape
+        # paired_slope needs — this is what closed the r4 42% interval
+        "timing_mode": "device (lax.fori_loop k rounds/dispatch)",
         "estimator": "paired-slope",
-        "estimator_fallbacks": int(used_fallback),
+        "estimator_fallbacks": dev_fb,
+        "range": throughput_range(dev_times, B * T),
+        "n_runs": len(dev_times),
+        "session_rtt_ms": round(measure_rtt(probe) * 1e3, 1),
     }
+
+    # --- eager secondary (the API-faithful surface), calibrated ----------
+    if not args.skip_eager:
+        params, opt_state = e_params, e_opt
+        loss = eloss
+        for _ in range(max(args.warmup - 1, 0)):
+            params, opt_state, loss = eager_step(params, opt_state)
+        device_sync(loss)
+
+        def eager_region(k):
+            nonlocal params, opt_state, loss
+            t0 = time.perf_counter()
+            for _ in range(k):
+                params, opt_state, loss = eager_step(params, opt_state)
+            device_sync(loss)
+            return time.perf_counter() - t0
+
+        # repeats=3: the eager loop's region noise (tunnel stalls of
+        # hundreds of ms) rivals a single delta; the conservative
+        # two-statistic estimate rides them out
+        dt_eager, eager_fb = paired_slope(
+            eager_region, args.iters, "bert-eager",
+            lambda: measure_rtt(probe), repeats=3)
+        out["eager_tok_s"] = round(B * T / dt_eager, 1)
+        out["eager_step_ms"] = round(dt_eager * 1e3, 1)
+        out["eager_estimator_fallbacks"] = int(eager_fb)
+        # calibration of the repeats-mode estimator against the
+        # slope-timable device number: >1 = eager dispatch-chain overhead
+        # (real API cost), <1 = the conservative estimator over-corrected
+        out["eager_over_device"] = round(dt_eager / dt_dev, 3)
+
     stats = getattr(jax.local_devices()[0], "memory_stats", lambda: None)()
     if stats and stats.get("peak_bytes_in_use"):
         out["peak_hbm_gb"] = round(stats["peak_bytes_in_use"] / 2**30, 2)

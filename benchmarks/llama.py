@@ -27,7 +27,7 @@ import optax
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import _sync, measure_rtt, paired_slope
+from bench import _sync, measure_rtt, paired_slope, robust_min, throughput_range
 import bluefog_tpu as bf
 from bluefog_tpu import topology_util
 from bluefog_tpu.core import basics
@@ -78,6 +78,10 @@ def main():
                     choices=sorted(PRESETS))
     ap.add_argument("--iters", type=int, default=10 if on_tpu else 3)
     ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--passes", type=int, default=3 if on_tpu else 1,
+                    help="paired-slope passes for the headline phase; the "
+                    "value is the stall-guarded min (bench.robust_min) and "
+                    "the JSON carries the full range (r4 verdict #7)")
     ap.add_argument("--attn-impl", default="auto",
                     choices=["auto", "xla", "pallas", "dense"],
                     help="flash-attention implementation (dense = model's "
@@ -188,7 +192,7 @@ def main():
         "adafactor": lambda: optax.adafactor(3e-4),
     }[cfg.get("optimizer", "adamw")]()
 
-    def timed(comm, plan):
+    def timed(comm, plan, passes=1):
         init_fn, step_fn = make_decentralized_train_step(
             lm_apply, opt, ctx.mesh,
             communication_type=comm, plan=plan, loss_fn=lm_loss,
@@ -215,20 +219,25 @@ def main():
         # there): cancels the constant per-region cost, fetch RTT AND
         # pipeline fill, where the previous (T - rt)/iters left the fill
         # share in (~5% at 134M's ~20 ms steps with iters=10)
-        t, fb = paired_slope(region, args.iters, "llama",
-                             lambda: measure_rtt(loss))
         nonlocal fallbacks
-        fallbacks += int(fb)
-        return t
+        ts = []
+        for _ in range(passes):
+            t, fb = paired_slope(region, args.iters, "llama",
+                                 lambda: measure_rtt(loss))
+            fallbacks += int(fb)
+            ts.append(t)
+        return ts
 
     fallbacks = 0
-    t_dec = timed(CommunicationType.neighbor_allreduce, ctx.plan)
+    dec_times = timed(CommunicationType.neighbor_allreduce, ctx.plan,
+                      passes=args.passes)
+    t_dec = robust_min(dec_times, "llama-dec")
     if n == 1 and cfg.get("remat"):
         # single-chip 1B: the exp2 plan has no edges so both phases run the
         # same program — skip the redundant (and memory-hungry) recompile
         t_ar = t_dec
     else:
-        t_ar = timed(CommunicationType.allreduce, None)
+        t_ar = min(timed(CommunicationType.allreduce, None))
 
     toks = B * T / t_dec
     # MFU convention (PaLM et al.): 6N flops/token fwd+bwd, NOT counting
@@ -254,7 +263,11 @@ def main():
             toks * (flops_per_tok + attn_per_tok) / 197e12, 3),
         # paired_slope's contract: surface when a phase fell back to the
         # RTT-subtracted estimator (0 = every figure is slope-timed)
+        "estimator": "paired-slope",
         "estimator_fallbacks": fallbacks,
+        # per-headline uncertainty in the contract (r4 verdict #7)
+        "range": throughput_range(dec_times, B * T),
+        "n_runs": len(dec_times),
     }
     stats = getattr(jax.local_devices()[0], "memory_stats", lambda: None)()
     if stats and stats.get("peak_bytes_in_use"):

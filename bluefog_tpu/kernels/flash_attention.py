@@ -111,8 +111,11 @@ _EXP2_ENABLED = os.environ.get("BLUEFOG_FLASH_EXP2", "0") != "0"
 # without touching the API.  Empty = backward inherits the forward blocks.
 _BWD_BLOCKS = None
 if os.environ.get("BLUEFOG_FLASH_BWD_BLOCKS"):
-    _BWD_BLOCKS = tuple(
-        int(x) for x in os.environ["BLUEFOG_FLASH_BWD_BLOCKS"].split("x"))
+    try:
+        _BWD_BLOCKS = tuple(
+            int(x) for x in os.environ["BLUEFOG_FLASH_BWD_BLOCKS"].split("x"))
+    except ValueError:
+        _BWD_BLOCKS = ()  # non-numeric parts get the same diagnostic
     if len(_BWD_BLOCKS) != 2:
         raise ValueError(
             "BLUEFOG_FLASH_BWD_BLOCKS must be 'BQxBK' (e.g. '512x1024'), "

@@ -356,6 +356,79 @@ def test_llama_head_chunks_matches_full():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+def test_final_quality_parity_head_to_head():
+    """Upstream's core claim made a regression test (r4 verdict #4 /
+    SURVEY §6 [U]): same model, same data, same seeds, fixed steps —
+    gossip (neighbor_allreduce exp2) and exact gradient tracking must
+    reach NEAR-IDENTICAL final eval quality to centralized allreduce,
+    with consensus spread -> 0.
+
+    Setup: small Llama on a deterministic next-token rule
+    (t+1 = 3t+1 mod V), heterogeneous shards (each rank sees different
+    sequences of the same rule), 120 steps through the flagship fused
+    train-step program (steps_per_call batches dispatches — the eager
+    per-step interleave can starve XLA:CPU's in-process rendezvous on a
+    1-core host).  Measured evals: allreduce 0.274, gossip 0.265, GT
+    0.241 — the decentralized methods land slightly BETTER here; the
+    assert bounds |delta| either way."""
+    from bluefog_tpu import algorithms
+    from bluefog_tpu.models.transformer import LlamaLM
+    from bluefog_tpu.training import make_lm_loss_fns
+
+    ctx = basics.context()
+    n = SIZE
+    V, T, B = 32, 16, 2
+    model = LlamaLM(vocab_size=V, hidden_size=24, num_layers=2,
+                    num_heads=4, dff=48, dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+
+    def make_seqs(k):
+        starts = rng.integers(0, V, size=k)
+        seqs = np.zeros((k, T), np.int64)
+        seqs[:, 0] = starts
+        for t in range(1, T):
+            seqs[:, t] = (3 * seqs[:, t - 1] + 1) % V
+        return seqs
+
+    train = jnp.asarray(make_seqs(n * B).reshape(n, B, T), jnp.int32)
+    eval_ids = jnp.asarray(make_seqs(32), jnp.int32)
+    p0 = replicate_for_mesh(
+        model.init(jax.random.PRNGKey(0), train[0])["params"], n)
+    lm_apply, lm_loss = make_lm_loss_fns(model)
+    K, CALLS, lr = 10, 12, 0.1
+
+    def run(comm, base):
+        init_fn, step_fn = make_decentralized_train_step(
+            lm_apply, base, ctx.mesh, communication_type=comm,
+            plan=(ctx.plan if comm == CommunicationType.neighbor_allreduce
+                  else None),
+            loss_fn=lm_loss, donate=False, steps_per_call=K)
+        params, state, bs = p0, init_fn(p0), {}
+        xb = jnp.broadcast_to(train[None], (K,) + train.shape)
+        for _ in range(CALLS):
+            params, bs, state, loss, _ = step_fn(params, bs, state, xb, xb)
+        mean_p = jax.tree_util.tree_map(lambda a: a.mean(0), params)
+        el = float(model.apply({"params": mean_p}, eval_ids,
+                               labels=eval_ids))
+        spread = max(float(np.asarray(l).std(axis=0).max())
+                     for l in jax.tree_util.tree_leaves(params))
+        return el, spread
+
+    ar, _ = run(CommunicationType.allreduce, optax.sgd(lr))
+    nar, nar_spread = run(CommunicationType.neighbor_allreduce,
+                          optax.sgd(lr))
+    # GT's comm lives inside the transform; CommunicationType.empty keeps
+    # the builder's combine an identity
+    gt, gt_spread = run(CommunicationType.empty,
+                        algorithms.gradient_tracking_spmd(lr, ctx.plan))
+
+    assert ar < 0.6, f"allreduce baseline failed to converge: {ar}"
+    assert abs(nar - ar) < 0.08, (nar, ar)
+    assert abs(gt - ar) < 0.08, (gt, ar)
+    assert nar_spread < 1e-2, nar_spread
+    assert gt_spread < 1e-3, gt_spread
+
+
 def test_llama_spmd_vocab_matches_default():
     """``spmd_vocab=True`` (one-hot-matmul embedding + one-hot target
     extraction, the vocab-sharded FSDP deployment mode) must be a pure
