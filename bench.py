@@ -117,25 +117,39 @@ def paired_slope(region, iters: int, label: str, fallback_rt,
     small = max(iters // 2, 1)
     if iters <= small:
         return subtract_rtt(region(iters), fallback_rt(), iters, label), True
-    deltas, t_smalls, t_bigs = [], [], []
+    t_smalls, t_bigs = [], []
     for _ in range(repeats):
         t_smalls.append(region(small))
         t_bigs.append(region(iters))
-        deltas.append(t_bigs[-1] - t_smalls[-1])
-    pos = [d for d in deltas if d > 0]
-    cands = pos and [min(pos)] or []
-    if min(t_bigs) - min(t_smalls) > 0:
-        cands.append(min(t_bigs) - min(t_smalls))
-    if cands:
-        return max(cands) / (iters - small), False
+    delta = conservative_delta(t_smalls, t_bigs)
+    if delta is not None:
+        return delta / (iters - small), False
     print(
         f"{label}: paired slope non-positive in all {repeats} round(s) "
-        f"(deltas {[round(d * 1e3, 1) for d in deltas]} ms) — falling "
-        "back to the guarded RTT-subtracted best big region (may carry "
-        "pipeline-fill overhead); raise iters for a trustworthy slope",
+        f"(deltas {[round((b - s) * 1e3, 1) for s, b in zip(t_smalls, t_bigs)]}"
+        " ms) — falling back to the guarded RTT-subtracted best big "
+        "region (may carry pipeline-fill overhead); raise iters for a "
+        "trustworthy slope",
         file=sys.stderr,
     )
     return subtract_rtt(min(t_bigs), fallback_rt(), iters, label), True
+
+
+def conservative_delta(t_smalls, t_bigs):
+    """The two-statistic conservative region delta — THE shared rule (see
+    ``paired_slope``'s docstring for each statistic's failure mode):
+    ``max(min positive paired delta, min(t_bigs) - min(t_smalls))``, or
+    None when both are non-positive (caller decides the fallback).
+    Shared by paired_slope, benchmarks/llama_decompose.py's layer-count
+    pairing, and attention_roofline's component slopes, so the protocols
+    cannot drift (r4 advisor: an independent re-implementation in
+    attention_fwd_ab had already dropped the floor statistic)."""
+    cands = [d for d in (
+        min((b - s for s, b in zip(t_smalls, t_bigs) if b - s > 0),
+            default=-1.0),
+        min(t_bigs) - min(t_smalls),
+    ) if d > 0]
+    return max(cands) if cands else None
 
 
 def subtract_rtt(total: float, rt: float, iters: int,

@@ -3,10 +3,14 @@ decomposition by layer-count slope (the methodology that pinned the
 ResNet ceiling in docs/STATUS.md round 3).
 
 Protocol: slope-time (``profiling.slope_time``: queued async calls, one
-sync, RTT cancels) the jitted fwd+bwd loss at two layer counts; the
-difference is the marginal cost of ``hi - lo`` decoder blocks, free of
-embed/head/dispatch.  The intercept (time at ``lo`` minus ``lo`` blocks)
-is embed + head + harness.  Each piece is compared against its
+sync, RTT cancels) the jitted fwd+bwd loss at two layer counts in
+INTERLEAVED rounds — lo and hi measured back to back inside each round,
+so the per-round delta cancels session drift the way ``paired_slope``
+cancels the region constant (r4 verdict #8: the sequential protocol's
+slope/intercept split moved 7.8/45.4 -> 11.25/18.75 ms between re-runs).
+The delta is the marginal cost of ``hi - lo`` decoder blocks, free of
+embed/head/dispatch; the intercept (min lo time minus ``lo`` blocks) is
+embed + head + harness.  Each piece is compared against its
 MXU-ideal time (6·flops at the measured 197 TF/s bf16 peak / 155 TF/s
 for f32-emulation matmuls) so the gap — memory-bound norms/rotary/
 softmax and scheduling — is measured, not guessed.
@@ -32,6 +36,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from bench import conservative_delta, robust_min
 from bluefog_tpu import profiling
 from bluefog_tpu.kernels import make_flash_attention_fn
 from bluefog_tpu.models.transformer import LlamaLM
@@ -94,22 +99,49 @@ def main():
                     choices=["flash", "dense", "none"],
                     help="attention inside the blocks (none = "
                     "pass-through, isolates the attention share)")
+    ap.add_argument("--rounds", type=int, default=3,
+                    help="interleaved lo/hi measurement rounds")
     args = ap.parse_args()
     cfg = PRESETS[args.preset]
     lo, hi = cfg["layers_lo"], cfg["layers_hi"]
 
-    times = {}
+    # Build and warm BOTH layer-count programs first, then measure them in
+    # INTERLEAVED rounds (r4 verdict #8: the r4 protocol measured lo fully
+    # before hi, so the slope/intercept split absorbed whatever the
+    # session drifted between the two phases — re-runs read 7.8/45.4 vs
+    # 11.25/18.75 ms.  A paired round shares one session window, so the
+    # per-round delta cancels the drift the way paired_slope cancels the
+    # region constant).
+    built = {}
     meta = {}
     effective_attn = args.attn
     for layers in (lo, hi):
         fn, params, ids, n_params, effective_attn = build_grad_fn(
             cfg, layers, on_tpu, args.head_bf16, args.attn)
-        times[layers] = profiling.slope_time(fn, (params, ids))
+        built[layers] = (fn, (params, ids))
         meta[layers] = n_params
 
+    t_los, t_his = [], []
+    for _ in range(max(args.rounds, 1)):
+        t_los.append(profiling.slope_time(*built[lo]))
+        t_his.append(profiling.slope_time(*built[hi]))
+
     toks = cfg["batch"] * cfg["seq"]
-    per_block = (times[hi] - times[lo]) / (hi - lo)
-    embed_head = times[lo] - lo * per_block
+    # bench.conservative_delta across rounds: per-round deltas are
+    # drift-paired, the floors guard stall-deflated rounds
+    delta = conservative_delta(t_los, t_his)
+    if delta is None:
+        print("llama_decompose: all paired layer-count deltas "
+              "non-positive — session too noisy, rerun", file=sys.stderr)
+        sys.exit(1)
+    per_block = delta / (hi - lo)
+    deltas = [(th - tl) / (hi - lo) for tl, th in zip(t_los, t_his)]
+    # robust_min, not min: a stall deflating one round's lo reading would
+    # deflate the intercept (embed_head could even print negative)
+    embed_head = robust_min(t_los, "decompose-lo") - lo * per_block
+    per_block_spread_pct = (
+        (max(deltas) - min(deltas)) / per_block * 100 if len(deltas) > 1
+        else 0.0)
 
     # MXU-ideal milliseconds: 6 flops/param/token fwd+bwd at the measured
     # 197 TF/s bf16 peak; the head's f32 3-pass emulation runs ~155
@@ -128,7 +160,13 @@ def main():
         "per_block_gap_x": round(per_block * 1e3 / max(ideal_block_ms, 1e-9), 2),
         "embed_head_ms": round(embed_head * 1e3, 2),
         "head_mxu_ideal_ms": round(ideal_head_ms, 2),
-        "step_ms_at_hi": round(times[hi] * 1e3, 2),
+        "step_ms_at_hi": round(robust_min(t_his, "decompose-hi") * 1e3, 2),
+        # interleaved-round transparency (r4 verdict #8): the per-round
+        # paired deltas and the spread the conservative pick came from
+        "per_block_rounds_ms": [round(d * 1e3, 2) for d in deltas],
+        "per_block_spread_pct": round(per_block_spread_pct, 1),
+        "n_rounds": len(deltas),
+        "estimator": "interleaved paired rounds (two-statistic)",
         "head_bf16": bool(args.head_bf16),
         "attn": args.attn,
         "effective_attn": effective_attn,
