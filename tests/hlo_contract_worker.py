@@ -113,6 +113,24 @@ def window_exchange_counts(n):
     return {"n_classes": nclasses, **dict(collective_counts(text))}
 
 
+def ring_attention_counts(n):
+    from bluefog_tpu.parallel import ring_attention as ra
+
+    bf.shutdown()
+    bf.init()
+    ctx = basics.context()
+    T, H, D = n * 16, 2, 8
+
+    def spmd(q, k, v):
+        return ra.ring_attention(q[0], k[0], v[0], NODES_AXIS, n,
+                                 causal=True, striped=True)[None]
+
+    fn = jax.shard_map(spmd, mesh=ctx.mesh, in_specs=(P(NODES_AXIS),) * 3,
+                       out_specs=P(NODES_AXIS))
+    x = jnp.zeros((n, 1, T // n, H, D), jnp.float32)
+    return _counts(fn, x, x, x)
+
+
 def main():
     n = int(sys.argv[1])
     assert len(jax.devices()) == n, (len(jax.devices()), n)
@@ -123,6 +141,7 @@ def main():
         "ring": neighbor_allreduce_counts(n, tu.RingGraph(n)),
         "gradient_tracking_exp2": gradient_tracking_counts(n),
         "window_exchange_exp2": window_exchange_counts(n),
+        "ring_attention_sp": ring_attention_counts(n),
     }
     if n == 32:
         # the pod shape: 8 machines x 4 local chips (v4-32-class)

@@ -351,6 +351,37 @@ def test_scan_stacked_leaves_never_gather_whole():
     )
 
 
+def test_ring_attention_sp_is_nearest_neighbor_only():
+    """Sequence-parallel ring attention (striped causal): the kv blocks
+    rotate one hop per step — exactly 2(n-1) collective-permutes forward
+    (k and v each rotate n-1 times) and 4(n-1) for fwd+bwd, ZERO
+    all-gathers/all-to-alls: per-hop traffic is nearest-neighbor and
+    rides ICI regardless of sequence length (the long-context scaling
+    story; scale law pinned at n=16/32 by test_hlo_contract_scale)."""
+    from bluefog_tpu.parallel import ring_attention as ra
+
+    ctx = basics.context()
+    n = SIZE
+    T, H, D = n * 16, 2, 8
+
+    def spmd(q, k, v):
+        return ra.ring_attention(q[0], k[0], v[0], NODES_AXIS, n,
+                                 causal=True, striped=True)[None]
+
+    fn = jax.shard_map(spmd, mesh=ctx.mesh, in_specs=(P(NODES_AXIS),) * 3,
+                       out_specs=P(NODES_AXIS))
+    x = jnp.ones((n, 1, T // n, H, D), jnp.float32)
+    counts = collective_counts(_compiled_text(fn, x, x, x))
+    _assert_only(counts, {"collective-permute": 2 * (n - 1)})
+
+    def loss(q, k, v):
+        return jnp.sum(jnp.sin(fn(q, k, v)))
+
+    g = jax.grad(loss, argnums=(0, 1, 2))
+    counts = collective_counts(_compiled_text(g, x, x, x))
+    _assert_only(counts, {"collective-permute": 4 * (n - 1)})
+
+
 def _exact_method_counts(tx, plan_topology=None):
     """Compile one optimizer-update step of an exact-method transform on
     the 8-rank mesh and return its collective inventory.  State comes from

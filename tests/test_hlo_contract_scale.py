@@ -70,6 +70,16 @@ def test_window_exchange_one_permute_per_class_at_scale(inventories):
         assert inv == {"collective-permute": nclasses}
 
 
+def test_ring_attention_sp_scales_linearly(inventories):
+    """Sequence-parallel ring attention: 2(n-1) permutes forward at every
+    n, zero all-gathers — per-hop traffic stays nearest-neighbor as the
+    ring grows (the long-context ICI story)."""
+    assert inventories[16]["ring_attention_sp"] == {
+        "collective-permute": 30}
+    assert inventories[32]["ring_attention_sp"] == {
+        "collective-permute": 62}
+
+
 def test_hierarchical_pod_shape(inventories):
     """8 machines x 4 local (v4-32-class pod): ONE local all-reduce plus
     machine-axis permutes only — exp2@8 machines = 3 classes, ring = 2;
