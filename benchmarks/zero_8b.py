@@ -187,6 +187,11 @@ def main():
                     "live under the CPU scheduler)")
     ap.add_argument("--layers", type=int, default=None,
                     help="override CFG layer count (default: full 32)")
+    ap.add_argument("--optimizer", default="sgdm",
+                    choices=["sgdm", "adamw"],
+                    help="sgdm (the shipping 8B choice) or adamw (two bf16 "
+                    "slots + count) — the --compile mode answers whether "
+                    "the Adam family fits the same budget")
     args = ap.parse_args()
     if args.execute_truncated is not None:
         execute_truncated(args.execute_truncated or [2, 3])
@@ -243,25 +248,38 @@ def main():
     init_fn, step_fn, _ = make_fsdp_gossip_train_step(
         apply_fn, loss_fn, ctx.hier_mesh, ctx.machine_plan,
         learning_rate=3e-4, momentum=0.9,
-        # bf16 momentum accumulator — the same choice the measured 134M/1B
-        # train configs ship (f32-accumulate, bf16-store); halves the
-        # optimizer shard: 4->2 GB/device at 8B, local=8
+        optimizer=args.optimizer,
+        # bf16 accumulators — the same choice the measured 134M/1B train
+        # configs ship (f32-accumulate, bf16-store); halves each optimizer
+        # shard: 4->2 GB/device per slot at 8B, local=8
         momentum_dtype=jnp.bfloat16,
     )
 
     # state ShapeDtypeStructs with the EXACT shardings init_fn would give
-    # (fsdp_state_struct shares init_fn's spec logic — no drift)
-    from bluefog_tpu.parallel.zero import fsdp_state_struct
+    # (fsdp_state_struct / fsdp_count_struct share init_fn's spec logic —
+    # no drift)
+    from bluefog_tpu.parallel.zero import fsdp_count_struct, fsdp_state_struct
 
     master = jax.tree_util.tree_map(
         lambda l: fsdp_state_struct(l, ctx.hier_mesh), p_shapes)
-    mu = jax.tree_util.tree_map(
-        lambda l: fsdp_state_struct(l, ctx.hier_mesh, dtype=jnp.bfloat16),
-        p_shapes)
+
+    def slot(dtype):
+        return jax.tree_util.tree_map(
+            lambda l: fsdp_state_struct(l, ctx.hier_mesh, dtype=dtype),
+            p_shapes)
+
+    if args.optimizer == "adamw":
+        # mu bf16; nu PINNED f32 (its 0.1%/step EMA decay is sub-ulp in
+        # bf16 and would freeze — parallel/zero.py _make_update_rule)
+        count = jax.tree_util.tree_map(
+            lambda l: fsdp_count_struct(l, ctx.hier_mesh), p_shapes)
+        opt = (slot(jnp.bfloat16), slot(jnp.float32), count)
+    else:
+        opt = (slot(jnp.bfloat16),)
     data_sh = NamedSharding(ctx.hier_mesh, P(MACHINES_AXIS, LOCAL_AXIS))
     ids_s = jax.ShapeDtypeStruct((machines, local * B, T), jnp.int32,
                                  sharding=data_sh)
-    lowered = step_fn.lower({"master": master, "opt": (mu,)}, ids_s, ids_s)
+    lowered = step_fn.lower({"master": master, "opt": opt}, ids_s, ids_s)
 
     if args.compile:
         # The r4-verdict memory tripwire: the full program COMPILED at its
@@ -281,6 +299,7 @@ def main():
         print(json.dumps({
             "metric": "8B FSDP+gossip full COMPILE + memory_analysis",
             "layers": layers,
+            "optimizer": args.optimizer,
             "leaves": "unrolled" if args.unrolled else "scan-stacked",
             "mesh": f"{machines}x{local}",
             "params_b": round(n_params / 1e9, 3),

@@ -48,6 +48,7 @@ __all__ = [
     "fsdp_act_constraint",
     "fsdp_onehot_constraint",
     "fsdp_param_io_constraint",
+    "fsdp_count_struct",
     "fsdp_state_struct",
     "packed_layout",
     "unpack_params",
@@ -230,7 +231,12 @@ def _make_update_rule(optimizer: str, lr: float, momentum: float,
         b1, b2, eps = float(momentum), 0.999, 1e-8
 
         def init(zeros_f32, zeros_i32):
-            return (zeros_f32(), zeros_f32(), zeros_i32())
+            # nu is pinned f32 REGARDLESS of the caller's accumulator
+            # dtype: its EMA decays by (1-b2) = 0.1%/step, below bf16's
+            # ~0.39% ulp — a bf16 nu can never decay and freezes at
+            # early-training values (mu's 10%/step increments survive
+            # bf16 fine, so mu honors the caller's dtype)
+            return (zeros_f32(), zeros_f32(jnp.float32), zeros_i32())
 
         def update(g, state, w):
             mu, nu, count = state
@@ -302,7 +308,8 @@ def make_zero_gossip_train_step(
         sharding = NamedSharding(hier_mesh, P(MACHINES_AXIS, LOCAL_AXIS))
         master = jax.device_put(grid, sharding)
         opt = opt_init(
-            lambda: jax.device_put(jnp.zeros_like(grid), sharding),
+            lambda dtype=None: jax.device_put(
+                jnp.zeros_like(grid, dtype=dtype), sharding),
             # per-replica step counter as [machines, local, 1] int32 so
             # every state leaf shares the (machines, local) spec
             lambda: jax.device_put(
@@ -416,6 +423,17 @@ def _fsdp_spec(shape, local_size: int) -> P:
     return P(*parts)
 
 
+def fsdp_count_struct(leaf, hier_mesh: Mesh):
+    """ShapeDtypeStruct for an adamw per-leaf step counter with EXACTLY
+    ``init_fn``'s layout ([machines, 1, ...] int32, machines-sharded) —
+    the AOT twin of the count factory in ``make_fsdp_gossip_train_step``
+    so feasibility checks cannot drift from the runtime state."""
+    machines, _ = hier_mesh.devices.shape
+    return jax.ShapeDtypeStruct(
+        (machines,) + (1,) * len(leaf.shape), jnp.int32,
+        sharding=NamedSharding(hier_mesh, P(MACHINES_AXIS)))
+
+
 def fsdp_state_struct(leaf, hier_mesh: Mesh, dtype=jnp.float32):
     """ShapeDtypeStruct for one master/momentum leaf with the EXACT
     sharding ``init_fn`` would give it — lets feasibility checks lower
@@ -482,8 +500,9 @@ def make_fsdp_gossip_train_step(
 
         master = jax.tree_util.tree_map(place, params)
         opt = opt_init(
-            lambda: jax.tree_util.tree_map(
-                lambda a: jnp.zeros_like(a, dtype=momentum_dtype), master),
+            lambda dtype=None: jax.tree_util.tree_map(
+                lambda a: jnp.zeros_like(a, dtype=dtype or momentum_dtype),
+                master),
             # per-replica, per-leaf step counter: [machines, 1, ...]
             # int32, broadcastable against its leaf
             lambda: jax.tree_util.tree_map(

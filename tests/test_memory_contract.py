@@ -234,6 +234,30 @@ def test_resnet50_train_step_memory():
     assert mem["temps"] < 15 * GB, mem
 
 
+def test_8b_adamw_full_compile_fits_16gb_at_2x16():
+    """The Adam family at 8B: mu bf16 + nu f32 (nu's 0.1%/step EMA decay
+    is sub-ulp in bf16 — it would freeze; ``_make_update_rule`` pins it
+    f32) + count push the 4x8 state to 10.04 GB/device (19.7 live — over
+    budget), but at local=16 the shards halve: validated 12.01 GB live at
+    the 2x16 mesh.  The contract pins the deployment answer: sgdm ships
+    at 4x8, adamw at 2x16."""
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=REPO,
+        ZERO8B_MESH="2x16",
+        XLA_FLAGS="--xla_force_host_platform_device_count=32",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "zero_8b.py"),
+         "--compile", "--optimizer", "adamw"],
+        env=env, capture_output=True, text=True, timeout=560, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["fits_16gb"] is True, out
+    assert out["optimizer"] == "adamw" and out["layers"] == 32, out
+
+
 def test_8b_full_compile_fits_16gb():
     """BASELINE config #5 (r4 verdict #1c/#4): the FULL 32-layer
     Llama-3-8B FSDP+gossip program at its deployment sharding (4 machines

@@ -220,6 +220,40 @@ def test_fsdp_bf16_momentum_tracks_f32(devices):
             np.asarray(got_f32[k], np.float32), rtol=0, atol=2e-2)
 
 
+def test_fsdp_adamw_nu_stays_f32_under_bf16_accumulators(devices):
+    """adamw's second moment must be f32 REGARDLESS of momentum_dtype:
+    its EMA decays by (1-b2) = 0.1%/step, below bf16's ~0.39% ulp — a
+    bf16 nu can never decay and freezes at early-training values (r5
+    code-review catch).  mu honors momentum_dtype; nu must not, and the
+    dtypes must survive a step (no silent drift)."""
+    from bluefog_tpu.parallel.zero import make_fsdp_gossip_train_step
+
+    ctx = _setup()
+    apply_fn, loss_fn, params = _model()
+    init_fn, step_fn, _ = make_fsdp_gossip_train_step(
+        apply_fn, loss_fn, ctx.hier_mesh, ctx.machine_plan,
+        learning_rate=LR, momentum=MOM, optimizer="adamw",
+        compute_dtype=jnp.float32, momentum_dtype=jnp.bfloat16,
+    )
+    state = init_fn(params)
+    mu, nu, count = state["opt"]
+    for lf in jax.tree_util.tree_leaves(mu):
+        assert lf.dtype == jnp.bfloat16
+    for lf in jax.tree_util.tree_leaves(nu):
+        assert lf.dtype == jnp.float32
+    rng = np.random.default_rng(13)
+    batch, labels = _data(rng)
+    state, loss = step_fn(
+        state, batch.reshape(MACHINES, LOCAL * 4, 6),
+        labels.reshape(MACHINES, LOCAL * 4, 3))
+    assert np.isfinite(float(loss))
+    mu, nu, count = state["opt"]
+    assert all(l.dtype == jnp.bfloat16
+               for l in jax.tree_util.tree_leaves(mu))
+    assert all(l.dtype == jnp.float32
+               for l in jax.tree_util.tree_leaves(nu))
+
+
 def test_fsdp_state_is_sharded(devices):
     from bluefog_tpu.parallel.zero import make_fsdp_gossip_train_step
 
