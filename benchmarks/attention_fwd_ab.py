@@ -71,7 +71,7 @@ from jax import lax
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import _sync, measure_rtt
+from bench import _sync, conservative_delta, measure_rtt
 from bluefog_tpu.kernels.flash_attention import flash_attention
 
 
@@ -133,24 +133,25 @@ def main():
     # window it occurred in (mins taken independently across repeats
     # could pair a fast-window small region with a slow-window big one
     # and inflate or negate the slope — review finding).  per-call =
-    # min over repeats of (T_big - T_small)/(group*chain).  Repeats stay
-    # impl-interleaved; rt is sampled per round purely as context.
-    deltas = {impl: [] for impl in runs}
+    # bench.conservative_delta(smalls, bigs)/(group*chain).  Repeats
+    # stay impl-interleaved; rt is sampled per round purely as context.
+    smalls = {impl: [] for impl in runs}
     big = {impl: [] for impl in runs}
     rts = []
     for _ in range(args.repeats):
         rts.append(measure_rtt(q0, n=2))
         for impl, run in runs.items():
-            t_small = region(run, args.group)
-            t_big = region(run, 2 * args.group)
-            deltas[impl].append(t_big - t_small)
-            big[impl].append(t_big)
+            smalls[impl].append(region(run, args.group))
+            big[impl].append(region(run, 2 * args.group))
     n_delta = args.chain * args.group
     per_call = {}
     fallbacks = []
     for impl in runs:
-        pos = [d for d in deltas[impl] if d > 0]
-        if not pos:
+        # THE shared two-statistic rule (bench.conservative_delta; its
+        # docstring records why an inline re-implementation here had
+        # already drifted once — r4 advisor finding)
+        delta = conservative_delta(smalls[impl], big[impl])
+        if delta is None:
             # noise exceeded the compute delta in every round —
             # conservative fallback, flagged in the JSON so a consumer
             # of the one-line contract sees the estimators differ
@@ -164,18 +165,7 @@ def main():
             fallbacks.append(impl)
             per_call[impl] = min(big[impl]) / (2 * n_delta)
         else:
-            # same two-statistic rule as bench.paired_slope (r4 advisor:
-            # min(pos) alone cherry-picks a stall-deflated delta — a
-            # stall in one repeat's SMALL region leaves its delta
-            # positive but too small, silently inflating the ratio).
-            # Both statistics' failure modes deflate per-call; take the
-            # conservative larger.
-            smalls = [b_ - d_ for b_, d_ in zip(big[impl], deltas[impl])]
-            cands = [min(pos)]
-            floor_delta = min(big[impl]) - min(smalls)
-            if floor_delta > 0:
-                cands.append(floor_delta)
-            per_call[impl] = max(cands) / n_delta
+            per_call[impl] = delta / n_delta
     tp, tx = per_call["pallas"], per_call["xla"]
     flops = 2 * 2 * b * h * t * t * d * 0.5  # qk+pv matmuls, causal half
     print(json.dumps({

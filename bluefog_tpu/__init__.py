@@ -14,6 +14,27 @@ idiomatic JAX: every collective is a pure function, usable both eagerly on
 per-rank ("rank-major") arrays and inside user ``jit``/``shard_map`` code.
 """
 
+import jax as _jax
+
+if not hasattr(_jax, "shard_map"):
+    # jax < 0.5 ships shard_map under jax.experimental with the same
+    # core keyword signature (mesh/in_specs/out_specs); alias it so the
+    # package (and its tests) run on either generation.  The newer
+    # partial-manual spelling ``axis_names={manual axes}`` maps to the
+    # older complement ``auto={the other mesh axes}``.
+    from jax.experimental import shard_map as _shard_map_mod
+
+    def _shard_map_compat(f, *, mesh, in_specs, out_specs, **kw):
+        axis_names = kw.pop("axis_names", None)
+        if axis_names is not None and "auto" not in kw:
+            kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if "check_vma" in kw and "check_rep" not in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        return _shard_map_mod.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+    _jax.shard_map = _shard_map_compat
+
 from bluefog_tpu.version import __version__
 
 from bluefog_tpu.core.basics import (

@@ -1,0 +1,58 @@
+"""bluefog_tpu.analysis — static verifier for the gossip runtime.
+
+Four rule families over the seed's load-bearing artifacts, one shared
+currency (:class:`~bluefog_tpu.analysis.engine.Finding`), three
+consumers (CLI, pytest, CI):
+
+- **plan** (:mod:`.plan_rules`) — every named topology x size 2..64:
+  shift classes are permutations, classes cover the edge set exactly,
+  the mixing matrix is doubly stochastic, the spectral gap is positive;
+- **hlo** (:mod:`.hlo_rules`, :mod:`.hlo_corpus`) — declarative lint of
+  post-partitioner HLO: collective budgets, no full-axis all-gather in
+  FSDP programs, no replicated large buffers;
+- **protocol** (:mod:`.seqlock_model`, :mod:`.epoch_rules`) — exhaustive
+  interleaving check of the shm-mailbox seqlock/collect/barrier at small
+  bounds, plus the window-op epoch-ordering lint;
+- the **fixture corpus** (:mod:`.fixtures`) — seeded bugs proving every
+  rule fires.
+
+Run ``python -m bluefog_tpu.analysis`` for the CLI (docs/ANALYSIS.md).
+
+Importing this package registers every rule; importing it does NOT
+touch a jax backend — only *running* the hlo family compiles programs.
+"""
+
+from bluefog_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    Report,
+    Rule,
+    Registry,
+    Severity,
+    registry,
+)
+
+# importing the family modules populates ``registry``
+from bluefog_tpu.analysis import (  # noqa: F401
+    epoch_rules,
+    fixtures,
+    hlo_corpus,
+    hlo_rules,
+    plan_rules,
+    seqlock_model,
+)
+
+__all__ = [
+    "Finding",
+    "Report",
+    "Rule",
+    "Registry",
+    "Severity",
+    "registry",
+    "run",
+]
+
+
+def run(families=None, verbose: bool = False) -> Report:
+    """Run the registered rules (all families by default); see
+    :meth:`Registry.run`."""
+    return registry.run(families=families, verbose=verbose)

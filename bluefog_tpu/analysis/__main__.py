@@ -1,0 +1,112 @@
+"""CLI: ``python -m bluefog_tpu.analysis`` — exit 0 iff no errors.
+
+Modes:
+
+- default: run every registered rule family over the default corpus and
+  print a summary (``--families plan protocol`` to subset, ``--no-hlo``
+  to skip the compile-heavy family — the fast CI gate);
+- ``--fixture NAME``: lint one seeded-bug fixture; exits NONZERO when it
+  (correctly) fires — CI uses this to prove the verifier catches what it
+  claims to catch;
+- ``--self-test``: run every fixture and fail unless each yields at
+  least one finding;
+- ``--list``: enumerate rules and fixtures;
+- ``--json``: machine-readable report.
+
+The 8-device CPU mesh is forced before jax initializes (same trick as
+tests/conftest.py) so the hlo family works on any host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _force_cpu_mesh() -> None:
+    # must run before jax picks a backend; harmless if already configured
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m bluefog_tpu.analysis",
+        description="static verifier: plans, topologies, HLO contracts, "
+                    "shm-mailbox protocol")
+    p.add_argument("--families", nargs="*", default=None,
+                   help="rule families to run (default: all)")
+    p.add_argument("--no-hlo", action="store_true",
+                   help="skip the compile-heavy hlo family (fast CI gate)")
+    p.add_argument("--fixture", default=None,
+                   help="lint one seeded-bug fixture; exits nonzero when "
+                        "the rule fires (it must)")
+    p.add_argument("--self-test", action="store_true",
+                   help="check every fixture yields >= 1 finding")
+    p.add_argument("--list", action="store_true", dest="list_rules",
+                   help="list registered rules and fixtures")
+    p.add_argument("--json", action="store_true", help="emit a JSON report")
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args(argv)
+
+    _force_cpu_mesh()
+
+    from bluefog_tpu import analysis
+    from bluefog_tpu.analysis import fixtures
+
+    if args.list_rules:
+        for rule in analysis.registry.select():
+            print(f"{rule.name:<36s} [{rule.family}] {rule.doc}")
+        print()
+        for name in fixtures.FIXTURES:
+            print(f"fixture: {name}")
+        return 0
+
+    if args.fixture is not None:
+        if args.fixture not in fixtures.FIXTURES:
+            p.error(f"unknown fixture {args.fixture!r}; see --list")
+        findings = fixtures.run_fixture(args.fixture)
+        for f in findings:
+            print(f)
+        print(f"{args.fixture}: {len(findings)} finding(s)")
+        # a seeded bug MUST be caught: nonzero exit = the rule fired
+        return 1 if findings else 0
+
+    if args.self_test:
+        dead = []
+        for name in fixtures.FIXTURES:
+            findings = fixtures.run_fixture(name)
+            status = f"fires ({len(findings)})" if findings else "SILENT"
+            print(f"  {name:<36s} {status}")
+            if not findings:
+                dead.append(name)
+        if dead:
+            print(f"self-test FAILED: rule(s) never fired for {dead}")
+            return 1
+        print(f"self-test OK: all {len(fixtures.FIXTURES)} seeded bugs "
+              "caught")
+        return 0
+
+    families = args.families
+    if args.no_hlo:
+        families = [f for f in (families or analysis.registry.families())
+                    if f != "hlo"]
+    report = analysis.run(families=families, verbose=args.verbose)
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=2, sort_keys=True))
+    else:
+        for f in report.findings:
+            print(f)
+        for name, value in sorted(report.metrics.items()):
+            print(f"  metric {name} = {value}")
+        print(report.summary())
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

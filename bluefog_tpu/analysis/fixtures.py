@@ -1,0 +1,179 @@
+"""Rule family 4: seeded-bug fixture corpus.
+
+Every rule family must be shown to FIRE, not just to pass — a verifier
+that has never caught anything proves nothing.  Each fixture here is a
+deliberately-broken artifact (a mutated plan, a tampered mixing weight,
+an HLO program with an injected all-gather, a protocol variant with a
+dropped fence, an ill-ordered window trace) paired with the rule set
+that must flag it.  ``run_fixture`` returns the findings; the CLI's
+``--fixture``/``--self-test`` modes and tests/test_analysis.py both
+demand a non-empty result for every name in :data:`FIXTURES`.
+
+Fixtures are built by *mutating real seed artifacts* (``compile_plan``
+output, the corpus topologies) rather than hand-writing broken objects,
+so a representation change that silently disarms a rule breaks the
+fixture too.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List
+
+from bluefog_tpu import topology_util as tu
+from bluefog_tpu.core.plan import compile_plan, plan_from_neighbor_lists
+
+from bluefog_tpu.analysis import epoch_rules, hlo_rules, plan_rules, seqlock_model
+from bluefog_tpu.analysis.engine import Finding
+
+__all__ = ["FIXTURES", "run_fixture"]
+
+
+def _seed_plan(size: int = 8):
+    topo = tu.ExponentialTwoGraph(size)
+    return topo, compile_plan(topo)
+
+
+# ---------------------------------------------------------------------------
+# plan fixtures: mutate a freshly compiled exp2@8 plan
+# ---------------------------------------------------------------------------
+
+
+def _plan_duplicate_destination() -> List[Finding]:
+    """Two class edges aimed at the same destination rank — not a
+    permutation, so one ppermute cannot realize the class."""
+    topo, plan = _seed_plan()
+    cls = plan.classes[0]
+    (s0, d0), (s1, d1) = cls.perm[0], cls.perm[1]
+    bad = dataclasses.replace(cls, perm=((s0, d0), (s1, d0)) + cls.perm[2:])
+    mutated = dataclasses.replace(plan, classes=(bad,) + plan.classes[1:])
+    return plan_rules.check_classes_are_permutations(mutated, "exp2@8[dup-dst]")
+
+
+def _plan_dropped_edge() -> List[Finding]:
+    """One scheduled edge removed: that neighbor never transfers and the
+    class cover no longer matches the topology."""
+    topo, plan = _seed_plan()
+    cls = plan.classes[0]
+    bad = dataclasses.replace(cls, perm=cls.perm[1:])
+    mutated = dataclasses.replace(plan, classes=(bad,) + plan.classes[1:])
+    return plan_rules.check_edge_cover(mutated, topo, "exp2@8[dropped-edge]")
+
+
+def _plan_tampered_weights() -> List[Finding]:
+    """One receive weight doubled: W rows stop summing to 1."""
+    topo, plan = _seed_plan()
+    cls = plan.classes[0]
+    rw = list(cls.recv_weights)
+    idx = next(i for i, w in enumerate(rw) if w != 0.0)
+    rw[idx] *= 2.0
+    bad = dataclasses.replace(cls, recv_weights=tuple(rw))
+    mutated = dataclasses.replace(plan, classes=(bad,) + plan.classes[1:])
+    return plan_rules.check_mixing_stochastic(mutated, "exp2@8[tampered-w]")
+
+
+def _plan_inconsistent_slots() -> List[Finding]:
+    """slot_index pointed at the wrong in-neighbor position: allgather
+    output placement would scramble."""
+    topo, plan = _seed_plan()
+    cls = plan.classes[0]
+    si = list(cls.slot_index)
+    recv = next(r for r in range(plan.size) if cls.recv_mask[r])
+    si[recv] = (si[recv] + 1) % max(plan.in_degrees[recv], 1) \
+        if plan.in_degrees[recv] > 1 else -1
+    bad = dataclasses.replace(cls, slot_index=tuple(si))
+    mutated = dataclasses.replace(plan, classes=(bad,) + plan.classes[1:])
+    return plan_rules.check_slot_consistency(mutated, "exp2@8[bad-slot]")
+
+
+def _plan_disconnected() -> List[Finding]:
+    """Two disjoint 4-cliques spelled as one 8-rank plan: W is block
+    diagonal, the second eigenvalue is 1, the spectral gap is zero."""
+    src_ranks = [[s for s in range((r // 4) * 4, (r // 4) * 4 + 4) if s != r]
+                 for r in range(8)]
+    plan = plan_from_neighbor_lists(8, src_ranks)
+    findings, _gap = plan_rules.check_spectral_gap(plan, "two-cliques@8")
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# HLO fixtures: real compiled text with an injected violation
+# ---------------------------------------------------------------------------
+
+# A post-partitioner-shaped module for a gossip step whose contract is
+# "collective-permute only".  The all-gather on the second line is the
+# injected bug: it re-materializes the full 8-way axis (and at f32
+# [8,4096,4096] it is also a 512 MB replicated buffer).
+_INJECTED_ALL_GATHER_HLO = """\
+HloModule jit_gossip_step, is_scheduled=true
+
+ENTRY %main.42 (param.0: f32[4096,4096]) -> f32[4096,4096] {
+  %param.0 = f32[4096,4096]{1,0} parameter(0)
+  %all-gather.1 = f32[8,4096,4096]{2,1,0} all-gather(%param.0), replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}
+  %slice.2 = f32[1,4096,4096]{2,1,0} slice(%all-gather.1), slice={[0:1], [0:4096], [0:4096]}
+  %reshape.3 = f32[4096,4096]{1,0} reshape(%slice.2)
+  %collective-permute.4 = f32[4096,4096]{1,0} collective-permute(%reshape.3), source_target_pairs={{0,1},{1,2},{2,3},{3,4},{4,5},{5,6},{6,7},{7,0}}
+  ROOT %add.5 = f32[4096,4096]{1,0} add(%reshape.3, %collective-permute.4)
+}
+"""
+
+
+def _hlo_injected_all_gather() -> List[Finding]:
+    rules = [
+        hlo_rules.CollectiveBudget({"collective-permute": 1},
+                                   subject="gossip_step[injected-ag]"),
+        hlo_rules.NoFullAxisAllGather(axis_size=8,
+                                      subject="gossip_step[injected-ag]"),
+    ]
+    return hlo_rules.check_program(_INJECTED_ALL_GATHER_HLO, rules)
+
+
+def _hlo_replicated_large_buffer() -> List[Finding]:
+    rules = [hlo_rules.NoReplicatedLargeBuffer(
+        max_bytes=64 * 2 ** 20, subject="gossip_step[512MB-gather]")]
+    return hlo_rules.check_program(_INJECTED_ALL_GATHER_HLO, rules)
+
+
+# ---------------------------------------------------------------------------
+# protocol fixtures: broken seqlock/collect/barrier variants + bad traces
+# ---------------------------------------------------------------------------
+
+
+def _model_fixture(model) -> List[Finding]:
+    return seqlock_model.check_model(model).findings
+
+
+FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
+    # plan family
+    "plan-duplicate-destination": _plan_duplicate_destination,
+    "plan-dropped-edge": _plan_dropped_edge,
+    "plan-tampered-weights": _plan_tampered_weights,
+    "plan-inconsistent-slots": _plan_inconsistent_slots,
+    "plan-disconnected-zero-gap": _plan_disconnected,
+    # hlo family
+    "hlo-injected-all-gather": _hlo_injected_all_gather,
+    "hlo-replicated-large-buffer": _hlo_replicated_large_buffer,
+    # protocol family: each drops one ingredient of the real protocol
+    "seqlock-skip-odd-phase": lambda: _model_fixture(
+        seqlock_model.seqlock_model(1, 2, odd_phase=False)),
+    "seqlock-publish-before-payload": lambda: _model_fixture(
+        seqlock_model.seqlock_model(1, 2, early_publish=True)),
+    "seqlock-no-writer-lock": lambda: _model_fixture(
+        seqlock_model.seqlock_model(2, 1, use_lock=False)),
+    "collect-split-critical-section": lambda: _model_fixture(
+        seqlock_model.collect_model(2, atomic_collect=False)),
+    "barrier-release-before-reset": lambda: _model_fixture(
+        seqlock_model.barrier_model(2, 2, reset_before_release=False)),
+    # epoch family: ill-ordered window traces
+    "epoch-use-after-free": lambda: epoch_rules.check_trace(
+        [("win_create", "w"), ("win_put", "w"), ("win_free", "w"),
+         ("win_update", "w")], subject="use-after-free"),
+    "epoch-get-clobbers-put": lambda: epoch_rules.check_trace(
+        [("win_create", "w"), ("win_put", "w"), ("win_get", "w"),
+         ("win_update", "w")], subject="get-clobbers-put"),
+}
+
+
+def run_fixture(name: str) -> List[Finding]:
+    """Build and lint one seeded-bug fixture; MUST return >= 1 finding."""
+    return FIXTURES[name]()

@@ -1,0 +1,167 @@
+"""Registered HLO rules: compile the seed's hot programs and lint them.
+
+The pure rule objects live in :mod:`bluefog_tpu.analysis.hlo_rules`; this
+module binds them to a REAL compiled corpus — ``neighbor_allreduce`` over
+each named topology at n=8 on the forced-8-device CPU mesh, plus the
+fused window exchange — and registers the result with the engine, so
+``python -m bluefog_tpu.analysis`` checks the same O(deg) contract the
+pytest suite pins (tests/test_hlo_contract.py), from the same rule
+objects.
+
+Compiling costs seconds per program (it runs GSPMD + the CPU backend),
+so this family is the slow one; the CLI's ``--no-hlo`` flag and the CI
+gate skip it while the full run and the pytest suite keep it honest.
+Everything here imports jax lazily — the plan/protocol families must
+stay runnable without touching a backend.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from bluefog_tpu.analysis.engine import Finding, Report, Severity, registry
+from bluefog_tpu.analysis.hlo_rules import (
+    CollectiveBudget,
+    NoFullAxisAllGather,
+    NoReplicatedLargeBuffer,
+    check_program,
+)
+
+SIZE = 8
+
+#: topology label -> (constructor, expected number of shift classes at n=8)
+GOSSIP_CORPUS = {
+    "exp2": ("ExponentialTwoGraph", 3),
+    "ring": ("RingGraph", 2),
+    "ring_uni": (None, 1),  # built inline (connect_style=1)
+    "full": ("FullyConnectedGraph", 7),
+}
+
+# any single collective result bigger than this on the n=8 toy shapes
+# means a buffer got replicated across the axis
+MAX_RESULT_BYTES = 1 << 20
+
+
+def _ensure_devices() -> bool:
+    import jax
+
+    return len(jax.devices()) >= SIZE
+
+
+def _gossip_text(topo):
+    """(post-partitioner text, #shift classes) of one rank-major
+    neighbor_allreduce."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import bluefog_tpu as bf
+    from bluefog_tpu import ops_spmd
+    from bluefog_tpu.core import basics
+    from bluefog_tpu.core.basics import NODES_AXIS
+
+    bf.set_topology(topo)
+    ctx = basics.context()
+    fn = jax.shard_map(
+        functools.partial(ops_spmd.neighbor_allreduce, plan=ctx.plan,
+                          axis_name=NODES_AXIS),
+        mesh=ctx.mesh, in_specs=P(NODES_AXIS), out_specs=P(NODES_AXIS))
+    x = jnp.zeros((SIZE, 4))
+    return jax.jit(fn).lower(x).compile().as_text(), len(ctx.plan.classes)
+
+
+def check_gossip_corpus(report: Report) -> None:
+    from bluefog_tpu import topology_util as tu
+
+    for label in GOSSIP_CORPUS:
+        if label == "ring_uni":
+            topo = tu.RingGraph(SIZE, connect_style=1)
+        else:
+            topo = getattr(tu, GOSSIP_CORPUS[label][0])(SIZE)
+        text, nclasses = _gossip_text(topo)
+        expect = GOSSIP_CORPUS[label][1]
+        subject = f"neighbor_allreduce/{label}@{SIZE}"
+        if nclasses != expect:
+            report.add(Finding(
+                "hlo.gossip-contract", subject,
+                f"plan compiled to {nclasses} shift classes (expected "
+                f"{expect})"))
+        rules = [
+            CollectiveBudget({"collective-permute": nclasses},
+                             subject=subject),
+            NoFullAxisAllGather(axis_size=SIZE, subject=subject),
+            NoReplicatedLargeBuffer(MAX_RESULT_BYTES, subject=subject),
+        ]
+        report.subjects_checked += 1
+        report.extend(check_program(text, rules))
+
+
+def check_window_exchange(report: Report) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    import bluefog_tpu as bf
+    from bluefog_tpu import topology_util as tu
+    from bluefog_tpu.core import basics
+    from bluefog_tpu.windows import _build_exchange
+
+    bf.set_topology(tu.ExponentialTwoGraph(SIZE))
+    ctx = basics.context()
+    plan = ctx.plan
+    nclasses = len(plan.classes)
+    maxd = plan.max_in_degree
+    x = jnp.zeros((SIZE, 4), jnp.float32)
+    mail = jnp.zeros((SIZE, maxd, 4), jnp.float32)
+    ver = jnp.zeros((SIZE, maxd), jnp.int32)
+    p_self = jnp.ones((SIZE,), jnp.float32)
+    p_mail = jnp.ones((SIZE, maxd), jnp.float32)
+    scales = jnp.ones((nclasses, SIZE), jnp.float32)
+    active = jnp.ones((nclasses, SIZE), jnp.float32)
+    f = _build_exchange(plan, accumulate=False, with_p=False, donate=False)
+    text = f.lower(x, mail, ver, p_self, p_mail, scales, active) \
+            .compile().as_text()
+    subject = f"win_exchange/exp2@{SIZE}"
+    rules = [
+        CollectiveBudget({"collective-permute": nclasses}, subject=subject),
+        NoFullAxisAllGather(axis_size=SIZE, subject=subject),
+        NoReplicatedLargeBuffer(MAX_RESULT_BYTES, subject=subject),
+    ]
+    report.subjects_checked += 1
+    report.extend(check_program(text, rules))
+
+
+def _with_context(report: Report, body) -> None:
+    import bluefog_tpu as bf
+    from bluefog_tpu.core import basics
+
+    if not _ensure_devices():
+        report.add(Finding(
+            "hlo.environment", "devices",
+            f"only {len(__import__('jax').devices())} devices visible "
+            f"(need {SIZE}); run via `python -m bluefog_tpu.analysis`, "
+            "which forces an 8-device CPU mesh", Severity.WARNING))
+        return
+    owned = not basics.is_initialized()
+    if owned:
+        bf.init(local_size=2)
+    try:
+        body(report)
+    finally:
+        if owned:
+            bf.shutdown()
+
+
+@registry.rule("hlo.gossip-contract", "hlo",
+               "neighbor_allreduce compiles to one permute per shift "
+               "class, no gathers, no replicated buffers")
+def _run_gossip(report: Report) -> None:
+    _with_context(report, check_gossip_corpus)
+
+
+@registry.rule("hlo.window-exchange", "hlo",
+               "the fused window exchange moves data only via one permute "
+               "per shift class")
+def _run_window(report: Report) -> None:
+    _with_context(report, check_window_exchange)

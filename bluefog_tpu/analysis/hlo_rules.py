@@ -1,0 +1,158 @@
+"""Rule family 2: declarative lint rules over post-partitioner HLO text.
+
+The HLO contract tests (tests/test_hlo_contract*.py) pin each program's
+collective inventory by hand; these rules make the same checks
+declarative objects that the tests, the CLI, and CI share — one
+semantics, three consumers.  Each rule's ``check(text)`` returns
+:class:`~bluefog_tpu.analysis.engine.Finding`s over the parsed
+instruction stream (``common/hlo_inspect.iter_ops``):
+
+- :class:`CollectiveBudget` — exact (or max) per-opcode collective
+  counts, unlisted collectives pinned to zero in exact mode.  The
+  O(deg)-gossip story is exactly "collective-permute == #shift classes,
+  everything else zero".
+- :class:`NoFullAxisAllGather` — no ``all-gather`` result may carry a
+  given axis extent in its leading dims; with the stacked-layer count it
+  is the "FSDP programs must not re-materialize full parameters" rule
+  (the scan-stacked 8B memory story).
+- :class:`NoReplicatedLargeBuffer` — no all-gather/broadcast result may
+  exceed a byte threshold; catches GSPMD resolutions that replicate a
+  big buffer even when the opcode budget still balances.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Mapping, Optional, Sequence
+
+from bluefog_tpu.common.hlo_inspect import (
+    COLLECTIVES,
+    collective_counts,
+    iter_ops,
+)
+
+from bluefog_tpu.analysis.engine import Finding, Severity
+
+__all__ = [
+    "CollectiveBudget",
+    "NoFullAxisAllGather",
+    "NoReplicatedLargeBuffer",
+    "check_program",
+    "assert_clean",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveBudget:
+    """Per-program collective-count budget.
+
+    ``exact=True`` (the contract-test mode): every listed opcode must
+    appear exactly its budgeted number of times and every *unlisted*
+    collective exactly zero times.  ``exact=False``: budgets are upper
+    bounds and unlisted collectives are unconstrained.
+    """
+
+    budgets: Mapping[str, int]
+    exact: bool = True
+    subject: str = "program"
+
+    def __post_init__(self):
+        unknown = set(self.budgets) - set(COLLECTIVES)
+        if unknown:
+            raise ValueError(
+                f"unknown collective opcode(s) {sorted(unknown)}; known: "
+                f"{list(COLLECTIVES)}")
+
+    def check_counts(self, counts: Mapping[str, int]) -> List[Finding]:
+        out: List[Finding] = []
+        for op in COLLECTIVES:
+            have = counts.get(op, 0)
+            want = self.budgets.get(op, 0 if self.exact else None)
+            if want is None:
+                continue
+            bad = have != want if self.exact else have > want
+            if bad:
+                rel = "expected exactly" if self.exact else "budget"
+                out.append(Finding(
+                    "hlo.collective-budget", self.subject,
+                    f"{have} x {op} ({rel} {want}); full inventory "
+                    f"{dict(counts)}"))
+        return out
+
+    def check(self, compiled_text: str) -> List[Finding]:
+        return self.check_counts(collective_counts(compiled_text))
+
+
+@dataclasses.dataclass(frozen=True)
+class NoFullAxisAllGather:
+    """No all-gather result may carry ``axis_size`` as either of its two
+    leading result dims.  With ``axis_size=num_layers`` on a scan-stacked
+    FSDP program this is the "no full-parameter re-materialization" rule:
+    a gather whose output is ``[layers, ...]`` has reassembled the whole
+    stacked leaf outside the layer loop."""
+
+    axis_size: int
+    subject: str = "program"
+
+    def check(self, compiled_text: str) -> List[Finding]:
+        out: List[Finding] = []
+        for op in iter_ops(compiled_text):
+            if op.opcode != "all-gather":
+                continue
+            for _, dims in op.shapes:
+                if dims[:1] == (self.axis_size,) or dims[1:2] == (self.axis_size,):
+                    out.append(Finding(
+                        "hlo.full-axis-all-gather", self.subject,
+                        f"all-gather result carries the full axis extent "
+                        f"{self.axis_size}: {op.line.strip()[:160]}"))
+                    break
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class NoReplicatedLargeBuffer:
+    """No all-gather or broadcast result may exceed ``max_bytes``.
+
+    The opcode budget can balance while a single gather blows the memory
+    story (the r5 8B campaign's dominators were exactly this shape);
+    byte-bounding the replicating opcodes catches it structurally.
+    """
+
+    max_bytes: int
+    opcodes: Sequence[str] = ("all-gather", "broadcast")
+    subject: str = "program"
+
+    def check(self, compiled_text: str) -> List[Finding]:
+        out: List[Finding] = []
+        for op in iter_ops(compiled_text):
+            if op.opcode not in self.opcodes:
+                continue
+            nbytes = op.result_bytes()
+            if nbytes > self.max_bytes:
+                out.append(Finding(
+                    "hlo.replicated-large-buffer", self.subject,
+                    f"{op.opcode} result is {nbytes / 1e6:.1f} MB "
+                    f"(> {self.max_bytes / 1e6:.1f} MB): "
+                    f"{op.line.strip()[:160]}"))
+        return out
+
+
+def check_program(compiled_text: str, rules: Sequence) -> List[Finding]:
+    """Run a rule set over one compiled program's text."""
+    findings: List[Finding] = []
+    for rule in rules:
+        findings.extend(rule.check(compiled_text))
+    return findings
+
+
+def assert_clean(compiled_text: str, rules: Sequence) -> None:
+    """pytest integration: raise AssertionError listing every finding.
+
+    The HLO contract tests call this instead of hand-rolled count
+    asserts, so a test failure and a CLI violation print the same rule
+    ids and messages."""
+    findings = check_program(compiled_text, rules)
+    errors = [f for f in findings if f.severity == Severity.ERROR]
+    if errors:
+        raise AssertionError(
+            "HLO contract violated:\n" + "\n".join(f"  {f}" for f in errors))

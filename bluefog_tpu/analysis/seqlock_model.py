@@ -1,0 +1,477 @@
+"""Rule family 3a: exhaustive model checking of the shm-mailbox protocol.
+
+``native/shm_mailbox.cc`` implements a seqlock mailbox (writers per-slot
+spinlocked with an odd/even sequence publish; readers wait-free with a
+bracketed retry copy), an atomic read+zero ``collect``, and a
+sense-reversing barrier.  MPI gives the reference this machinery for
+free; here it is 449 lines of hand-rolled C++ that had never been model
+checked.  This module mirrors each protocol as a small explicit-state
+machine and exhaustively enumerates ALL interleavings at small bounds
+(1-2 writers x 1-2 deposits, 2-word payloads, 2-3 ranks x 2 barrier
+episodes), proving within those bounds:
+
+- **no torn read**: every payload a completed reader returns is a single
+  deposit's value, never a mix of two (seqlock safety);
+- **no lost deposit**: ``collect``'s read+zero critical section conserves
+  mass against a concurrent accumulating writer;
+- **no lost wakeup / deadlock**: the barrier's reset-then-release order
+  can never strand a rank spinning on a generation bump that already
+  happened.
+
+The step orders are imported from ``native/shm_native.py``'s protocol
+spec constants and asserted to match, so the model cannot silently drift
+from the implementation it vouches for.  Seeded-bug variants (writer
+skips the odd phase; collect splits read and zero; barrier releases
+before resetting) are exported for the fixture corpus — each must make
+the checker fire (tests/test_analysis.py).
+
+The model assumes sequential consistency.  The fences in shm_mailbox.cc
+(seq_cst store-store before the payload mutation, release before the
+even publish, acquire-bracketed reads) are exactly what collapses the
+hardware's weaker orders to the interleaving semantics checked here;
+the comments at ``slot_write``/``slot_read`` document that mapping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from bluefog_tpu.native.shm_native import (
+    BARRIER_RESET_BEFORE_RELEASE,
+    COLLECT_IS_ATOMIC,
+    SEQLOCK_READER_STEPS,
+    SEQLOCK_WRITER_STEPS,
+)
+
+from bluefog_tpu.analysis.engine import Finding, Report, registry
+
+__all__ = [
+    "Model",
+    "explore",
+    "seqlock_model",
+    "collect_model",
+    "barrier_model",
+    "check_model",
+]
+
+
+# ---------------------------------------------------------------------------
+# tiny explicit-state explorer
+# ---------------------------------------------------------------------------
+#
+# A process is a list of *steps*.  A step is
+#     step(shared: dict, regs: dict) -> list[(shared', regs', next_pc)]
+# returning every successor from this state (deterministic steps return
+# one; a blocked spin returns none).  Steps must treat their inputs as
+# immutable and may set shared["_bad"] to a message to flag a safety
+# violation at that transition.
+
+
+@dataclasses.dataclass
+class Model:
+    name: str
+    shared: Dict
+    programs: List[List[Callable]]
+    final_check: Optional[Callable[[Dict], Optional[str]]] = None
+
+
+def _freeze(d: Dict) -> Tuple:
+    return tuple(sorted(d.items()))
+
+
+def _thaw(t: Tuple) -> Dict:
+    return dict(t)
+
+
+def explore(model: Model, max_states: int = 1_000_000) -> List[str]:
+    """DFS over every interleaving; returns violation messages.
+
+    Detects three failure shapes: a step-flagged safety violation
+    (``shared["_bad"]``), a deadlock (some process unfinished, no process
+    can move — the lost-wakeup signature), and a failed ``final_check``
+    on a fully-terminated state.
+    """
+    programs = model.programs
+    init = (_freeze(model.shared),
+            tuple((0, ()) for _ in programs))
+    seen = {init}
+    stack = [init]
+    violations: List[str] = []
+    flagged = set()
+
+    def flag(msg: str) -> None:
+        if msg not in flagged:
+            flagged.add(msg)
+            violations.append(msg)
+
+    while stack:
+        shared_t, procs = stack.pop()
+        shared = _thaw(shared_t)
+        any_move = False
+        all_done = True
+        for i, (pc, regs_t) in enumerate(procs):
+            prog = programs[i]
+            if pc >= len(prog):
+                continue
+            all_done = False
+            regs = _thaw(regs_t)
+            for sh2, rg2, pc2 in prog[pc](shared, regs):
+                any_move = True
+                bad = sh2.pop("_bad", None)
+                if bad is not None:
+                    flag(f"{model.name}: {bad}")
+                    continue  # prune past the violation
+                nxt = (_freeze(sh2),
+                       procs[:i] + ((pc2, _freeze(rg2)),) + procs[i + 1:])
+                if nxt not in seen:
+                    if len(seen) >= max_states:
+                        raise RuntimeError(
+                            f"{model.name}: state space exceeded "
+                            f"{max_states} states — tighten the bounds")
+                    seen.add(nxt)
+                    stack.append(nxt)
+        if all_done:
+            if model.final_check is not None:
+                msg = model.final_check(shared)
+                if msg:
+                    flag(f"{model.name}: {msg}")
+        elif not any_move:
+            stuck = [i for i, (pc, _) in enumerate(procs)
+                     if pc < len(programs[i])]
+            flag(f"{model.name}: deadlock — process(es) {stuck} blocked "
+                 "forever (lost wakeup)")
+    return violations
+
+
+def _s(shared, regs, pc, **updates):
+    """One successor with shared-var updates applied."""
+    sh = dict(shared)
+    sh.update(updates)
+    return [(sh, regs, pc)]
+
+
+def _r(shared, regs, pc, **updates):
+    """One successor with register updates applied."""
+    rg = dict(regs)
+    rg.update(updates)
+    return [(shared, rg, pc)]
+
+
+# ---------------------------------------------------------------------------
+# model 1: seqlock write/read (torn-read safety)
+# ---------------------------------------------------------------------------
+
+
+def _writer_program(writer_id: int, deposits: int, words: int,
+                    use_lock: bool, odd_phase: bool,
+                    early_publish: bool) -> Tuple[List[Callable], Tuple[str, ...]]:
+    """One writer: ``deposits`` sequential slot_write calls, each writing
+    the deposit's unique value to every payload word, one word per step
+    (the memcpy is not atomic — that is the whole point)."""
+    prog: List[Callable] = []
+    steps: List[str] = []
+
+    for dep in range(deposits):
+        value = writer_id * 100 + dep + 1
+
+        # Each closure captures its own next-pc at construction time.
+        def mk_acquire(next_pc):
+            def step(sh, rg):
+                if sh["lock"]:
+                    return []
+                return _s(sh, rg, next_pc, lock=1)
+            return step
+
+        def mk_seq_bump(next_pc):
+            def step(sh, rg):
+                return _s(sh, rg, next_pc, seq=sh["seq"] + 1)
+            return step
+
+        def mk_write_word(w, v, next_pc):
+            def step(sh, rg):
+                return _s(sh, rg, next_pc, **{f"w{w}": v})
+            return step
+
+        def mk_release(next_pc):
+            def step(sh, rg):
+                return _s(sh, rg, next_pc, lock=0)
+            return step
+
+        base = len(prog)
+        seq_bumps = ([("seq_to_odd", mk_seq_bump)] if odd_phase else [])
+        publish = [("seq_to_even", mk_seq_bump)]
+        body: List[Tuple[str, Callable]] = []
+        if use_lock:
+            body.append(("acquire_lock", mk_acquire))
+        body.extend(seq_bumps)
+        if early_publish:
+            body.extend(publish)
+        body.extend(("mutate_payload", lambda nxt, w=w, v=value:
+                     mk_write_word(w, v, nxt)) for w in range(words))
+        if not early_publish:
+            body.extend(publish)
+        if use_lock:
+            body.append(("release_lock", mk_release))
+        for k, (name, maker) in enumerate(body):
+            prog.append(maker(base + k + 1))
+            steps.append(name)
+    return prog, tuple(steps)
+
+
+def _reader_program(words: int, check_after: bool = True) -> List[Callable]:
+    """slot_read: bracketed retry copy, no lock.  Registers: ``before``
+    and one ``r<w>`` per word.  On completion the snapshot must be a
+    single deposit's value."""
+    pc_start = 0
+
+    def read_before(sh, rg):
+        if sh["seq"] & 1:
+            return [(sh, rg, pc_start)]  # odd: retry (self-loop via state)
+        return _r(sh, rg, 1, before=sh["seq"])
+
+    prog: List[Callable] = [read_before]
+
+    def mk_copy(w, next_pc):
+        def step(sh, rg):
+            return _r(sh, rg, next_pc, **{f"r{w}": sh[f"w{w}"]})
+        return step
+
+    for w in range(words):
+        prog.append(mk_copy(w, len(prog) + 1))
+
+    def read_after(sh, rg):
+        if check_after and sh["seq"] != rg["before"]:
+            return [(sh, {}, pc_start)]  # retry from scratch
+        vals = {rg[f"r{w}"] for w in range(words)}
+        if len(vals) > 1:
+            sh2 = dict(sh)
+            sh2["_bad"] = (f"torn read: completed snapshot mixes deposits "
+                           f"{sorted(vals)}")
+            return [(sh2, rg, len(prog))]
+        return [(sh, rg, len(prog))]
+
+    prog.append(read_after)
+    return prog
+
+
+def seqlock_model(n_writers: int = 1, deposits: int = 2, words: int = 2,
+                  use_lock: bool = True, odd_phase: bool = True,
+                  early_publish: bool = False,
+                  reader_checks_after: bool = True) -> Model:
+    """The mailbox slot under concurrent writers and one wait-free reader.
+
+    Default parameters mirror ``slot_write``/``slot_read`` exactly (order
+    asserted against the shm_native protocol spec); the keyword knobs
+    produce the seeded-bug variants for the fixture corpus."""
+    shared = {"lock": 0, "seq": 0}
+    for w in range(words):
+        shared[f"w{w}"] = 0
+    programs = []
+    for i in range(n_writers):
+        prog, steps = _writer_program(i, deposits, words, use_lock,
+                                      odd_phase, early_publish)
+        if (use_lock and odd_phase and not early_publish):
+            # one deposit's step-name sequence must equal the impl spec
+            per_dep = steps[:len(steps) // deposits]
+            collapsed = tuple(
+                name for k, name in enumerate(per_dep)
+                if name != "mutate_payload" or
+                (k == 0 or per_dep[k - 1] != "mutate_payload"))
+            assert collapsed == SEQLOCK_WRITER_STEPS, (
+                f"model drifted from shm_native.SEQLOCK_WRITER_STEPS: "
+                f"{collapsed}")
+        programs.append(prog)
+    programs.append(_reader_program(words, check_after=reader_checks_after))
+    assert len(SEQLOCK_READER_STEPS) == 3  # spec sync (retry-bracketed copy)
+    return Model(name="seqlock", shared=shared, programs=programs)
+
+
+# ---------------------------------------------------------------------------
+# model 2: collect vs concurrent accumulate (mass conservation)
+# ---------------------------------------------------------------------------
+
+
+def collect_model(deposits: int = 2, atomic_collect: bool = COLLECT_IS_ATOMIC
+                  ) -> Model:
+    """One accumulating writer (``bf_shm_win_write`` mode 1) racing one
+    ``collect`` drain (``bf_shm_win_read`` collect=1).  Mass conservation:
+    every deposited unit is either collected or still in the slot when
+    both finish.  ``atomic_collect=False`` models the seeded bug — a
+    seqlock *read* followed by a separate locked zero — which loses any
+    deposit that lands in between."""
+    shared = {"lock": 0, "seq": 0, "m": 0, "collected": 0}
+
+    writer: List[Callable] = []
+    for dep in range(deposits):
+        base = len(writer)
+
+        def mk(step_idx):
+            def acquire(sh, rg):
+                if sh["lock"]:
+                    return []
+                return _s(sh, rg, step_idx + 1, lock=1)
+            return acquire
+
+        writer.append(mk(base))
+
+        def mk_read(nxt):
+            def step(sh, rg):
+                return _r(sh, rg, nxt, tmp=sh["m"])
+            return step
+
+        writer.append(mk_read(base + 2))
+
+        def mk_addback(nxt):
+            def step(sh, rg):
+                return _s(sh, rg, nxt, m=rg["tmp"] + 1)
+            return step
+
+        writer.append(mk_addback(base + 3))
+
+        def mk_release(nxt):
+            def step(sh, rg):
+                return _s(sh, rg, nxt, lock=0)
+            return step
+
+        writer.append(mk_release(base + 4))
+
+    if atomic_collect:
+        def c_acquire(sh, rg):
+            if sh["lock"]:
+                return []
+            return _s(sh, rg, 1, lock=1)
+
+        def c_read_zero(sh, rg):
+            sh2 = dict(sh)
+            sh2["collected"] = sh["collected"] + sh["m"]
+            sh2["m"] = 0
+            return [(sh2, rg, 2)]
+
+        def c_release(sh, rg):
+            return _s(sh, rg, 3, lock=0)
+
+        collector = [c_acquire, c_read_zero, c_release]
+    else:
+        # seeded bug: read outside the critical section, zero inside
+        def c_read(sh, rg):
+            return _r(sh, rg, 1, got=sh["m"])
+
+        def c_acquire(sh, rg):
+            if sh["lock"]:
+                return []
+            return _s(sh, rg, 2, lock=1)
+
+        def c_zero(sh, rg):
+            sh2 = dict(sh)
+            sh2["collected"] = sh["collected"] + rg["got"]
+            sh2["m"] = 0
+            return [(sh2, rg, 3)]
+
+        def c_release(sh, rg):
+            return _s(sh, rg, 4, lock=0)
+
+        collector = [c_read, c_acquire, c_zero, c_release]
+
+    def conserved(sh) -> Optional[str]:
+        if sh["collected"] + sh["m"] != deposits:
+            return (f"lost deposit: {deposits} deposited but "
+                    f"collected={sh['collected']} + remaining={sh['m']}")
+        return None
+
+    return Model(name="collect", shared=shared,
+                 programs=[writer, collector], final_check=conserved)
+
+
+# ---------------------------------------------------------------------------
+# model 3: sense-reversing barrier (lost wakeup)
+# ---------------------------------------------------------------------------
+
+
+def barrier_model(nranks: int = 2, episodes: int = 2,
+                  reset_before_release: bool = BARRIER_RESET_BEFORE_RELEASE
+                  ) -> Model:
+    """``bf_shm_job_barrier`` at small bounds.  The last arriver resets
+    ``arrived`` then bumps ``generation``; every other rank spins on the
+    bump.  ``reset_before_release=False`` is the seeded bug — releasing
+    first lets a fast rank enter the next episode and have its arrival
+    wiped by the late reset, deadlocking everyone (the lost wakeup)."""
+    shared = {"arrived": 0, "generation": 0}
+
+    def make_rank() -> List[Callable]:
+        prog: List[Callable] = []
+        for _ in range(episodes):
+            base = len(prog)
+            # pcs within one episode: base+0 read gen / fetch_add,
+            # base+1 reset-or-spin, base+2 release (last arriver only)
+            def arrive(sh, rg, base=base):
+                a = sh["arrived"] + 1
+                rg2 = dict(rg)
+                rg2["gen"] = sh["generation"]
+                rg2["last"] = 1 if a == nranks else 0
+                return [(dict(sh, arrived=a), rg2, base + 1)]
+
+            def reset_or_spin(sh, rg, base=base):
+                if rg["last"]:
+                    if reset_before_release:
+                        return _s(sh, rg, base + 2, arrived=0)
+                    return _s(sh, rg, base + 2,
+                              generation=sh["generation"] + 1)
+                if sh["generation"] == rg["gen"]:
+                    return []  # spin on the bump
+                return [(sh, rg, base + 3)]
+
+            def release(sh, rg, base=base):
+                if not rg["last"]:
+                    return [(sh, rg, base + 3)]
+                if reset_before_release:
+                    return _s(sh, rg, base + 3,
+                              generation=sh["generation"] + 1)
+                return _s(sh, rg, base + 3, arrived=0)
+
+            prog.extend([arrive, reset_or_spin, release])
+        return prog
+
+    return Model(name="barrier", shared=shared,
+                 programs=[make_rank() for _ in range(nranks)])
+
+
+# ---------------------------------------------------------------------------
+# report plumbing + registration
+# ---------------------------------------------------------------------------
+
+
+def check_model(model: Model, report: Optional[Report] = None,
+                rule: str = "protocol.model") -> Report:
+    report = report if report is not None else Report()
+    report.subjects_checked += 1
+    for msg in explore(model):
+        report.add(Finding(rule, model.name, msg))
+    return report
+
+
+@registry.rule("protocol.seqlock-torn-read", "protocol",
+               "no interleaving of seqlock writers with a wait-free "
+               "reader yields a torn snapshot")
+def _run_seqlock(report: Report) -> None:
+    for n_writers, deposits in ((1, 2), (2, 1), (2, 2)):
+        check_model(
+            seqlock_model(n_writers=n_writers, deposits=deposits),
+            report, rule="protocol.seqlock-torn-read")
+
+
+@registry.rule("protocol.collect-mass-conservation", "protocol",
+               "collect's read+zero critical section loses no concurrent "
+               "deposit")
+def _run_collect(report: Report) -> None:
+    for deposits in (1, 2, 3):
+        check_model(collect_model(deposits=deposits), report,
+                    rule="protocol.collect-mass-conservation")
+
+
+@registry.rule("protocol.barrier-lost-wakeup", "protocol",
+               "the sense-reversing barrier can never strand a rank")
+def _run_barrier(report: Report) -> None:
+    for nranks, episodes in ((2, 2), (3, 2)):
+        check_model(barrier_model(nranks=nranks, episodes=episodes),
+                    report, rule="protocol.barrier-lost-wakeup")

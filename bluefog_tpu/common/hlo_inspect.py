@@ -11,8 +11,10 @@ MPI calls a collective op issues (``mpi_controller.cc`` [U]); here the
 
 from __future__ import annotations
 
+import dataclasses
 import re
 from collections import Counter
+from typing import Iterator, Tuple
 
 COLLECTIVES = (
     "all-reduce",
@@ -24,6 +26,68 @@ COLLECTIVES = (
 
 # opcode sits after `=` and the (possibly tuple) result type
 _OP_RE = re.compile(r"=\s*(?:\([^)]*\)|[^\s(]+)\s+([a-z][a-z0-9\-]*)\(")
+
+# one instruction line: result name `=` result type(s) opcode `(`
+_LINE_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(\([^)]*\)|[^\s(]+)\s+"
+    r"([a-z][a-z0-9\-]*)\(")
+
+# a typed shape inside a result type, e.g. ``bf16[6,64,128]{2,1,0}``
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16, "f8e4m3fn": 1, "f8e5m2": 1,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class HloOp:
+    """One parsed HLO instruction: opcode (``-start`` forms normalized to
+    the base opcode, ``-done`` forms dropped by :func:`iter_ops`'s
+    collective filter), its result shapes, and the raw line."""
+
+    opcode: str
+    shapes: Tuple[Tuple[str, Tuple[int, ...]], ...]  # (dtype, dims) per result
+    line: str
+
+    def result_bytes(self) -> int:
+        """Total bytes across result shapes (0 for unknown dtypes)."""
+        total = 0
+        for dtype, dims in self.shapes:
+            n = 1
+            for d in dims:
+                n *= d
+            total += n * _DTYPE_BYTES.get(dtype, 0)
+        return total
+
+
+def iter_ops(compiled_text: str) -> Iterator[HloOp]:
+    """Parse every instruction line of ``compiled.as_text()`` into an
+    :class:`HloOp`.  Async ``-done`` instructions are skipped and
+    ``-start`` opcodes are normalized, mirroring :func:`collective_counts`
+    so shape-aware rules and the counter can never disagree on what is
+    one logical op."""
+    for line in compiled_text.splitlines():
+        m = _LINE_RE.match(line)
+        if not m:
+            continue
+        op = m.group(2)
+        if op.endswith("-done"):
+            continue
+        if op.endswith("-start"):
+            op = op[: -len("-start")]
+        shapes = tuple(
+            (dt, tuple(int(x) for x in dims.split(",") if x))
+            for dt, dims in _SHAPE_RE.findall(m.group(1))
+        )
+        yield HloOp(opcode=op, shapes=shapes, line=line)
+
+
+def collective_ops(compiled_text: str) -> list:
+    """The :data:`COLLECTIVES` subset of :func:`iter_ops`."""
+    return [op for op in iter_ops(compiled_text) if op.opcode in COLLECTIVES]
 
 
 def collective_counts(compiled_text: str) -> Counter:

@@ -251,6 +251,16 @@ class BlueFogContext:
 _context: Optional[BlueFogContext] = None
 
 
+def _distributed_is_initialized() -> bool:
+    """jax < 0.5 has no ``jax.distributed.is_initialized``; fall back to the
+    client handle the service keeps on the module (None until initialize)."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    state = getattr(jax.distributed, "global_state", None)
+    return state is not None and getattr(state, "client", None) is not None
+
+
 def init(
     topology: Optional[nx.DiGraph] = None,
     *,
@@ -279,7 +289,7 @@ def init(
     # NB: probing jax.process_count() here would itself initialize the XLA
     # backend and make jax.distributed.initialize raise — ask the
     # distributed service directly whether it is already up
-    if distributed and not jax.distributed.is_initialized():
+    if distributed and not _distributed_is_initialized():
         # jax.distributed.initialize only auto-detects num_processes /
         # process_id on TPU/Slurm/OMPI — forward bftpu-run's env explicitly
         # so plain multi-host (CPU sim included) bootstraps too

@@ -435,6 +435,14 @@ class LlamaLM(nn.Module):
     # device (~2.5 GB/layer of temps) and zero reduce-scatters.  Same
     # params, same math (tests/test_training.py::test_llama_spmd_vocab_
     # matches_default); the one-hot matmul is also the MXU-native lookup.
+    # BEHAVIORAL DIFFERENCE on out-of-range token ids (only): gather-based
+    # ``take``/``take_along_axis`` CLAMP the id to the vocab edge, so a
+    # corrupt id silently embeds as (and extracts the logit of) the last
+    # vocab entry; ``one_hot`` ZEROES — an out-of-range id embeds as the
+    # zero vector and contributes -logsumexp (no target logit) to the
+    # loss.  Neither mode validates ids; both are garbage-in, but the
+    # garbage differs, so a dataset bug can shift metrics when toggling
+    # this flag.  In-range ids are bit-identical between modes.
     spmd_vocab: bool = False
     # applied to the [B, T, d] hidden states after the embedding and after
     # every decoder block — the standard GSPMD FSDP recipe pins the
@@ -536,6 +544,16 @@ class LlamaLM(nn.Module):
             # sharding-only pin per chunk (keeps the scan-transpose
             # accumulator sharded); the cast already happened above
             wc = self.weight_constraint
+            if wc is not None and not hasattr(wc, "sharding_only"):
+                raise ValueError(
+                    "head_chunks > 1 with a custom weight_constraint "
+                    "requires a .sharding_only attribute (the per-chunk "
+                    "pin without the grad-dtype cast, cf. parallel/zero."
+                    "fsdp_param_io_constraint): passing the full "
+                    "constraint would re-round the head-kernel cotangent "
+                    "once per chunk instead of once on the accumulated "
+                    "gradient"
+                )
             return chunked_softmax_cross_entropy(
                 x, kernel, labels, self.head_chunks, dtype=self.head_dtype,
                 onehot_targets=self.spmd_vocab,

@@ -30,6 +30,46 @@ from bluefog_tpu.native import get_lib
 
 _DTYPE_CODES = {np.dtype(np.float32): 1, np.dtype(np.float64): 2}
 
+# ---------------------------------------------------------------------------
+# protocol specification (model-checked)
+# ---------------------------------------------------------------------------
+#
+# The seqlock step orders below are the ground truth the static verifier's
+# exhaustive interleaving model (bluefog_tpu/analysis/seqlock_model.py)
+# mirrors; the model asserts its generated programs match these tuples, so
+# a protocol change in shm_mailbox.cc must update BOTH this spec and the
+# model — the checker cannot silently drift from the implementation.
+
+#: slot_write() in shm_mailbox.cc: spinlock, seq -> odd, mutate payload,
+#: seq -> even (release), unlock.  The odd phase is what makes concurrent
+#: plain readers retry instead of copying a half-written payload.
+SEQLOCK_WRITER_STEPS = (
+    "acquire_lock",
+    "seq_to_odd",
+    "mutate_payload",
+    "seq_to_even",
+    "release_lock",
+)
+
+#: slot_read() in shm_mailbox.cc: wait-free w.r.t. writers — no lock;
+#: retry until the same even seq brackets the whole copy.
+SEQLOCK_READER_STEPS = (
+    "read_seq_before_retry_if_odd",
+    "copy_payload",
+    "read_seq_after_retry_if_changed",
+)
+
+#: bf_shm_win_read(collect=1): the read AND the zero happen inside ONE
+#: slot_write critical section — the push-sum mass-conservation primitive
+#: (a deposit can never land between the read and the zero).
+COLLECT_IS_ATOMIC = True
+
+#: bf_shm_job_barrier(): sense-reversing — the last arriver must reset
+#: ``arrived`` BEFORE bumping ``generation``; the opposite order loses the
+#: arrival of a rank that races into the next episode (model-checked
+#: lost-wakeup).
+BARRIER_RESET_BEFORE_RELEASE = True
+
 
 def seg_name(job: str, suffix: str) -> str:
     """Sanitized POSIX shm object name (leading slash, [A-Za-z0-9_.-])."""

@@ -53,7 +53,10 @@ def resolve_axis_size(axis_name: str, axis_size) -> int:
     inside a trace, an error outside one.
     """
     try:
-        n = lax.axis_size(axis_name)
+        # lax.axis_size is current jax; psum of a literal constant-folds
+        # to the bound axis size as a Python int on versions without it
+        n = (lax.axis_size(axis_name) if hasattr(lax, "axis_size")
+             else lax.psum(1, axis_name))
     except NameError:
         if axis_size is None:
             raise

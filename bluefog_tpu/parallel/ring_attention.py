@@ -72,7 +72,7 @@ def unstripe_blocks(x, n: int, axis: int = 1):
 def striped_positions(t_local: int, axis_name: str):
     """Global positions of this device's striped shard (``i*n + idx``) —
     feed to rotary/positional encodings when training striped."""
-    n = lax.axis_size(axis_name)
+    n = resolve_axis_size(axis_name, None)
     return jnp.arange(t_local) * n + lax.axis_index(axis_name)
 
 

@@ -70,9 +70,37 @@ __all__ = [
     "win_set_exposed",
     "turn_on_win_ops_with_associated_p",
     "turn_off_win_ops_with_associated_p",
+    "record_win_ops",
 ]
 
 WeightsArg = Union[None, Sequence[Dict[int, float]]]
+
+# ``record_win_ops`` trace target; None = recording off (zero-cost path)
+_OP_LOG: Optional[List[Tuple[str, str]]] = None
+
+
+@contextlib.contextmanager
+def record_win_ops():
+    """Record ``(op, window_name)`` for every public win op in the block,
+    yielding the live event list.  The epoch-ordering lint
+    (``bluefog_tpu.analysis.epoch_rules.check_trace``) consumes this trace,
+    so a real training loop's window usage can be checked against the
+    use-before-create / use-after-free / mixed-deposit-epoch rules exactly
+    as the analysis CLI checks canned traces.  Nested recorders share the
+    outer list; ``win_free(None)`` logs with name ``"*"``."""
+    global _OP_LOG
+    prev = _OP_LOG
+    log = [] if prev is None else prev
+    _OP_LOG = log
+    try:
+        yield log
+    finally:
+        _OP_LOG = prev
+
+
+def _log_op(op: str, name: Optional[str]) -> None:
+    if _OP_LOG is not None:
+        _OP_LOG.append((op, "*" if name is None else name))
 
 
 class _Window:
@@ -455,6 +483,7 @@ def win_create(tensor, name: str, zero_init: bool = False) -> bool:
     (reference ``bf.win_create(tensor, name, zero_init)`` [U]; the pytree
     form subsumes its fusion buffer).  The window's neighbor structure
     snapshots the currently-installed topology."""
+    _log_op("win_create", name)
     ctx = _ctx()
     # _fusion_split performs the multi-host conversion for both forms
     meta, tensor = _fusion_split(tensor)
@@ -473,6 +502,7 @@ def win_create(tensor, name: str, zero_init: bool = False) -> bool:
 
 def win_free(name: Optional[str] = None) -> bool:
     """Free one window, or all when name is None (reference ``bf.win_free`` [U])."""
+    _log_op("win_free", name)
     ctx = _ctx()
     if name is None:
         ctx.windows.clear()
@@ -510,6 +540,7 @@ def win_put(tensor, name: str, dst_weights: WeightsArg = None) -> bool:
     the tensor's memory, so the put value *is* the current exposure.
     """
     with timeline_context("win_put"):
+        _log_op("win_put", name)
         win = _win(name)
         tensor = basics.to_rank_major_global(tensor)
         scales, active = _class_scales(win.plan, dst_weights, side="send")
@@ -545,6 +576,7 @@ def win_accumulate(tensor, name: str, dst_weights: WeightsArg = None) -> bool:
     """Like win_put but adds into the destination slot (reference
     ``bf.win_accumulate`` — MPI_Accumulate path [U])."""
     with timeline_context("win_accumulate"):
+        _log_op("win_accumulate", name)
         win = _win(name)
         tensor = basics.to_rank_major_global(tensor)
         scales, active = _class_scales(win.plan, dst_weights, side="send")
@@ -569,6 +601,7 @@ def win_get(name: str, src_weights: WeightsArg = None) -> bool:
     """Pull in-neighbors' exposed tensors into my mailbox slots, optionally
     receiver-scaled (reference ``bf.win_get`` — MPI_Get path [U])."""
     with timeline_context("win_get"):
+        _log_op("win_get", name)
         win = _win(name)
         # A get of s's exposed tensor by d == a put of s's tensor to d with
         # receiver-side scaling, under the lockstep schedule.
@@ -651,6 +684,7 @@ def win_update(
     mailbox (and associated p) after reading — the accumulate idiom.
     """
     with timeline_context("win_update"):
+        _log_op("win_update", name)
         ctx = _ctx()
         win = _win(name)
         maxd = max(win.plan.max_in_degree, 1)
@@ -739,6 +773,7 @@ def win_put_update(
     back, and one dispatch lets XLA schedule the exchange with the combine.
     """
     with timeline_context("win_put_update"):
+        _log_op("win_put_update", name)
         ctx = _ctx()
         win = _win(name)
         tensor = basics.to_rank_major_global(tensor)
@@ -832,6 +867,7 @@ def win_update_then_collect(name: str, require_mutex: bool = False):
             "bulk-synchronous emulation (atomic by construction); the "
             "islands runtime takes a real mutex"
         )
+    _log_op("win_update_then_collect", name)
     ctx = _ctx()
     win = _win(name)
     ones = [
@@ -887,6 +923,7 @@ def win_set_exposed(name: str, tensor, associated_p=None) -> None:
     the caller stores x/p back as the new x and resets p to 1.  The reference
     gets this for free because its windows alias the torch tensor [U]; the
     mailbox emulation needs an explicit setter."""
+    _log_op("win_set_exposed", name)
     win = _win(name)
     tensor = basics.to_rank_major_global(tensor)
     t = jnp.asarray(_pack_input(name, tensor), dtype=win.dtype)

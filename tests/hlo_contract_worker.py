@@ -27,9 +27,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import bluefog_tpu as bf
 from bluefog_tpu import ops_spmd, topology_util as tu
+from bluefog_tpu.analysis.hlo_rules import NoFullAxisAllGather, check_program
 from bluefog_tpu.common.hlo_inspect import collective_counts
 from bluefog_tpu.core import basics
 from bluefog_tpu.core.basics import LOCAL_AXIS, MACHINES_AXIS, NODES_AXIS
+
+# every compiled text is ALSO linted against the shared full-axis rule
+# (no all-gather result may carry the device-axis extent); violations
+# accumulate here and ride the JSON for the parent to assert empty
+VIOLATIONS = []
 
 
 def _rank_major(spmd_fn, mesh):
@@ -37,9 +43,16 @@ def _rank_major(spmd_fn, mesh):
                          out_specs=P(NODES_AXIS))
 
 
-def _counts(fn, *args):
+def _lint(text, subject):
+    n = len(jax.devices())
+    VIOLATIONS.extend(str(f) for f in check_program(
+        text, [NoFullAxisAllGather(axis_size=n, subject=subject)]))
+    return text
+
+
+def _counts(fn, *args, subject="program"):
     return dict(collective_counts(
-        jax.jit(fn).lower(*args).compile().as_text()))
+        _lint(jax.jit(fn).lower(*args).compile().as_text(), subject)))
 
 
 def neighbor_allreduce_counts(n, topology):
@@ -108,8 +121,8 @@ def window_exchange_counts(n):
     scales = jnp.ones((nclasses, n), jnp.float32)
     active = jnp.ones((nclasses, n), jnp.float32)
     f = _build_exchange(plan, accumulate=False, with_p=False, donate=False)
-    text = f.lower(x, mail, ver, p_self, p_mail, scales,
-                   active).compile().as_text()
+    text = _lint(f.lower(x, mail, ver, p_self, p_mail, scales,
+                         active).compile().as_text(), "window_exchange")
     return {"n_classes": nclasses, **dict(collective_counts(text))}
 
 
@@ -148,6 +161,7 @@ def main():
         out["hier_8x4_exp2"] = hierarchical_counts(
             32, 8, tu.ExponentialTwoGraph(8))
         out["hier_8x4_ring"] = hierarchical_counts(32, 8, tu.RingGraph(8))
+    out["violations"] = VIOLATIONS
     print(json.dumps(out))
 
 

@@ -1,0 +1,107 @@
+"""HLO rule family on REAL compiled programs (ISSUE: passing case on
+seed artifacts + seeded-bug fixture per family).
+
+The contract suite (test_hlo_contract*.py) consumes these rules for its
+per-path pins; here the rules themselves are under test — the parser,
+the budget/gather/byte checks on genuine post-partitioner text, and the
+registered corpus rules end to end.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import bluefog_tpu as bf
+from bluefog_tpu import ops_spmd, topology_util as tu
+from bluefog_tpu.analysis import Report, fixtures, hlo_corpus
+from bluefog_tpu.analysis.hlo_rules import (
+    CollectiveBudget,
+    NoFullAxisAllGather,
+    NoReplicatedLargeBuffer,
+    assert_clean,
+    check_program,
+)
+from bluefog_tpu.common.hlo_inspect import HloOp, collective_ops, iter_ops
+from bluefog_tpu.core import basics
+from bluefog_tpu.core.basics import NODES_AXIS
+
+SIZE = 8
+
+
+@pytest.fixture(autouse=True)
+def fresh_context(devices):
+    bf.init(local_size=2)
+    yield
+    bf.shutdown()
+
+
+def _gossip_text(topo):
+    bf.set_topology(topo)
+    ctx = basics.context()
+    fn = jax.shard_map(
+        functools.partial(ops_spmd.neighbor_allreduce, plan=ctx.plan,
+                          axis_name=NODES_AXIS),
+        mesh=ctx.mesh, in_specs=P(NODES_AXIS), out_specs=P(NODES_AXIS))
+    x = jnp.zeros((SIZE, 4))
+    return jax.jit(fn).lower(x).compile().as_text()
+
+
+def test_parser_sees_the_permutes():
+    text = _gossip_text(tu.ExponentialTwoGraph(SIZE))
+    ops = collective_ops(text)
+    assert [op.opcode for op in ops] == ["collective-permute"] * 3
+    # every parsed op carries a usable shape
+    assert all(op.result_bytes() > 0 for op in ops)
+
+
+def test_result_bytes_arithmetic():
+    op = next(iter_ops(
+        "  %x = f32[8,4096,4096]{2,1,0} all-gather(%p), dimensions={0}\n"))
+    assert isinstance(op, HloOp)
+    assert op.result_bytes() == 4 * 8 * 4096 * 4096
+
+
+def test_real_gossip_passes_the_rules():
+    text = _gossip_text(tu.ExponentialTwoGraph(SIZE))
+    assert_clean(text, [
+        CollectiveBudget({"collective-permute": 3}, subject="exp2@8"),
+        NoFullAxisAllGather(axis_size=SIZE, subject="exp2@8"),
+        NoReplicatedLargeBuffer(1 << 20, subject="exp2@8"),
+    ])
+
+
+def test_budget_rule_fires_on_injected_all_gather():
+    findings = fixtures.run_fixture("hlo-injected-all-gather")
+    rules_fired = {f.rule for f in findings}
+    assert rules_fired == {"hlo.collective-budget",
+                           "hlo.full-axis-all-gather"}
+
+
+def test_byte_rule_fires_on_replicated_large_buffer():
+    findings = fixtures.run_fixture("hlo-replicated-large-buffer")
+    assert [f.rule for f in findings] == ["hlo.replicated-large-buffer"]
+    assert "536.9 MB" in findings[0].message  # 8*4096*4096*4 bytes
+
+
+def test_budget_rejects_unknown_opcode_at_construction():
+    with pytest.raises(ValueError, match="unknown collective"):
+        CollectiveBudget({"all-togther": 1})  # typo must fail loudly
+
+
+def test_inexact_budget_is_upper_bound_only():
+    text = _gossip_text(tu.RingGraph(SIZE))
+    assert check_program(text, [CollectiveBudget(
+        {"collective-permute": 5}, exact=False)]) == []
+    assert check_program(text, [CollectiveBudget(
+        {"collective-permute": 1}, exact=False)]) != []
+
+
+def test_registered_hlo_corpus_rules_pass_on_seed():
+    report = Report()
+    hlo_corpus.check_gossip_corpus(report)
+    hlo_corpus.check_window_exchange(report)
+    assert report.ok, "\n".join(str(f) for f in report.errors())
+    assert report.subjects_checked == len(hlo_corpus.GOSSIP_CORPUS) + 1

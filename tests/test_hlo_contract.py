@@ -12,10 +12,16 @@ edges, ``bluefog/common/mpi_controller.cc`` [U]).
 Method follows ``benchmarks/scan_gather_probe.py``: ``jit(...).lower(...)
 .compile().as_text()`` and count collective opcodes.  ``-start`` forms
 count once; ``-done`` forms are ignored.
+
+The assertions are the analysis engine's declarative HLO rules
+(``bluefog_tpu.analysis.hlo_rules``) — the same rule objects the
+``python -m bluefog_tpu.analysis`` CLI runs over its compiled corpus —
+so a contract has one definition with three consumers (pytest, CLI, CI)
+and a test failure prints the same rule id and message as a CLI
+violation.
 """
 
 import functools
-import re
 from collections import Counter
 
 import jax
@@ -29,7 +35,12 @@ from bluefog_tpu import ops_spmd, topology_util as tu
 from bluefog_tpu.core import basics
 from bluefog_tpu.core.basics import LOCAL_AXIS, MACHINES_AXIS, NODES_AXIS
 
-from bluefog_tpu.common.hlo_inspect import COLLECTIVES, collective_counts
+from bluefog_tpu.analysis.hlo_rules import (
+    CollectiveBudget,
+    NoFullAxisAllGather,
+    assert_clean,
+)
+from bluefog_tpu.common.hlo_inspect import collective_counts
 
 SIZE = 8
 
@@ -51,13 +62,11 @@ def _rank_major(spmd_fn, mesh):
 
 
 def _assert_only(counts: Counter, expected: dict):
-    """Exact inventory: every listed opcode at its exact count, every
-    unlisted collective at zero."""
-    for op in COLLECTIVES:
-        assert counts.get(op, 0) == expected.get(op, 0), (
-            f"collective inventory drifted: expected {expected}, got "
-            f"{dict(counts)}"
-        )
+    """Exact inventory via the shared CollectiveBudget rule: every listed
+    opcode at its exact count, every unlisted collective at zero."""
+    findings = CollectiveBudget(expected).check_counts(counts)
+    assert not findings, "HLO contract violated:\n" + "\n".join(
+        f"  {f}" for f in findings)
 
 
 def test_allreduce_is_one_allreduce():
@@ -326,25 +335,11 @@ def test_scan_stacked_leaves_never_gather_whole():
     text = step_fn.lower(
         {"master": master, "opt": (mu,)}, ids_s, ids_s).compile().as_text()
 
-    # find all-gather result shapes carrying the full [layers, ...] axis
-    op_re = re.compile(
-        r"^\s*(?:ROOT\s+)?%?\S+\s*=\s*(\([^)]*\)|\S+)\s*all-gather(?:-start)?\(")
-    full_stack = 0
-    for line in text.splitlines():
-        m = op_re.match(line)
-        if not m:
-            continue
-        for dims in re.findall(r"\[([\d,]+)\]", m.group(1)):
-            parts = [int(x) for x in dims.split(",") if x]
-            if parts[:1] == [layers] or parts[1:2] == [layers]:
-                full_stack += 1
-                break
-    assert full_stack == 0, (
-        f"{full_stack} all-gathers carry the full stacked layer axis — the "
-        "scan-stacked FSDP memory story (8B at 15.6 GB/device) depends on "
-        "no whole-stack gathers; check the constraint set and the ppermute "
-        "gossip combine"
-    )
+    # no all-gather result may carry the full [layers, ...] axis — the
+    # scan-stacked FSDP memory story (8B at 15.6 GB/device) depends on no
+    # whole-stack gathers; same rule the analysis CLI runs
+    assert_clean(text, [NoFullAxisAllGather(
+        axis_size=layers, subject="fsdp_gossip_step")])
     counts = collective_counts(text)
     assert counts.get("collective-permute", 0) >= 1, (
         f"gossip combine lost its permutes: {dict(counts)}"

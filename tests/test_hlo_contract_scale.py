@@ -44,6 +44,14 @@ def inventories():
     return {n: _run_worker(n) for n in (16, 32)}
 
 
+def test_no_full_axis_gather_at_scale(inventories):
+    """The worker lints every compiled text with the shared
+    NoFullAxisAllGather rule (analysis.hlo_rules); any firing rides the
+    JSON back here."""
+    for n in (16, 32):
+        assert inventories[n]["violations"] == []
+
+
 def test_exp2_permutes_scale_logarithmically(inventories):
     assert inventories[16]["exp2"] == {"collective-permute": 4}
     assert inventories[32]["exp2"] == {"collective-permute": 5}
