@@ -499,10 +499,13 @@ def main():
     if bw_isl is not None:
         headline["island_win_put_gbs_per_rank"] = bw_isl["value"]
         headline["island_win_put_metric"] = bw_isl["metric"]
-        headline["island_win_put_vs_raw_memcpy"] = bw_isl["vs_baseline"]
+        headline["island_win_put_vs_raw_memcpy"] = bw_isl["vs_raw_memcpy"]
+        # v2 chunk-ring transport shape (what the numbers were taken at)
+        headline["island_chunk_bytes"] = bw_isl["chunk_bytes"]
+        headline["island_pipeline_depth"] = bw_isl["pipeline_depth"]
     if bw_proto is not None:
         headline["island_protocol_ceiling_gbs"] = bw_proto["value"]
-        headline["island_protocol_vs_raw_memcpy"] = bw_proto["vs_baseline"]
+        headline["island_protocol_vs_raw_memcpy"] = bw_proto["vs_raw_memcpy"]
     print(json.dumps(headline))
 
 

@@ -95,6 +95,7 @@ def main():
     args = ap.parse_args()
 
     from bluefog_tpu import islands
+    from bluefog_tpu.native import shm_native
 
     res = islands.spawn(
         _worker, 2, args=(args.steps, args.mb, args.inner), timeout=900.0)
@@ -108,6 +109,11 @@ def main():
         "d2h_ms_per_round": r0["d2h_ms_per_round"],
         "payload_mb": r0["payload_mb"],
         "rank0_platform": r0["platform"],
+        # transport the background thread's deposits ran through, plus the
+        # v2 chunk-ring shape (the gossip leg of every overlapped round)
+        "transport": shm_native.island_transport(),
+        "chunk_bytes": shm_native.chunk_bytes(),
+        "pipeline_depth": shm_native.pipeline_depth(),
     }))
 
 

@@ -164,6 +164,16 @@ FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
         seqlock_model.collect_model(2, atomic_collect=False)),
     "barrier-release-before-reset": lambda: _model_fixture(
         seqlock_model.barrier_model(2, 2, reset_before_release=False)),
+    # protocol v2 (chunk-ring) family: each drops one ingredient of
+    # slot_deposit / the drained-marker drain
+    "chunk-ring-missing-commit-fence": lambda: _model_fixture(
+        seqlock_model.chunk_ring_model(2, 2, commit_fence=False)),
+    "chunk-ring-reordered-commit": lambda: _model_fixture(
+        seqlock_model.chunk_ring_model(2, 1, words=1,
+                                       in_order_commit=False,
+                                       frontier_reader=True)),
+    "chunk-drained-split-collect": lambda: _model_fixture(
+        seqlock_model.drained_collect_model(2, atomic_collect=False)),
     # epoch family: ill-ordered window traces
     "epoch-use-after-free": lambda: epoch_rules.check_trace(
         [("win_create", "w"), ("win_put", "w"), ("win_free", "w"),

@@ -39,7 +39,11 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from bluefog_tpu.native.shm_native import (
     BARRIER_RESET_BEFORE_RELEASE,
+    CHUNK_COMMIT_IN_ORDER,
+    CHUNK_READER_STEPS,
+    CHUNK_WRITER_STEPS,
     COLLECT_IS_ATOMIC,
+    DRAINED_COLLECT_IS_ATOMIC,
     SEQLOCK_READER_STEPS,
     SEQLOCK_WRITER_STEPS,
 )
@@ -52,6 +56,8 @@ __all__ = [
     "seqlock_model",
     "collect_model",
     "barrier_model",
+    "chunk_ring_model",
+    "drained_collect_model",
     "check_model",
 ]
 
@@ -384,6 +390,262 @@ def collect_model(deposits: int = 2, atomic_collect: bool = COLLECT_IS_ATOMIC
 
 
 # ---------------------------------------------------------------------------
+# model 2b: chunk-ring commit protocol (protocol v2 — torn chunk /
+# reordered commit / missing commit fence)
+# ---------------------------------------------------------------------------
+#
+# slot_deposit in the v2 transport splits the payload into chunks, each
+# guarded by its OWN seqlock, committed in ascending index order:
+#     for c in chunks: cs[c] -> odd; fence; write chunk c; release; cs[c] -> even
+# Two consumer shapes depend on different halves of that contract:
+#   * the per-chunk bracketed reader (slot_read, probe's drain leg) needs
+#     each chunk's odd/even bracket to actually cover the chunk's bytes —
+#     a commit published before the payload lands (missing fence) lets a
+#     bracket with before == after return a half-written chunk;
+#   * the pipelined frontier reader (bf_shm_win_probe's consumer chasing
+#     the commit frontier) additionally needs the ASCENDING commit order:
+#     observing chunk LAST committed at episode d must imply every earlier
+#     chunk already carries episode >= d.
+# Both are modeled below; the seeded-bug knobs break exactly one promise
+# each and must make the corresponding reader fire.
+
+
+def _chunk_writer_program(nchunks: int, deposits: int, words: int,
+                          in_order_commit: bool, commit_fence: bool
+                          ) -> List[Callable]:
+    """One depositing writer: per episode e (value e+1), commit every
+    chunk under its own seqlock.  ``in_order_commit=False`` commits in
+    DESCENDING index order (the reordered-commit bug); ``commit_fence=
+    False`` publishes the even value BEFORE the chunk's words are written
+    (the missing release-fence bug, modeled at SC as the reordered
+    publish it permits on hardware)."""
+    prog: List[Callable] = []
+
+    def mk_seq_bump(c, next_pc):
+        def step(sh, rg):
+            return _s(sh, rg, next_pc, **{f"cs{c}": sh[f"cs{c}"] + 1})
+        return step
+
+    def mk_write_word(c, w, v, next_pc):
+        def step(sh, rg):
+            return _s(sh, rg, next_pc, **{f"c{c}w{w}": v})
+        return step
+
+    spec_names: List[str] = []
+    for dep in range(deposits):
+        value = dep + 1
+        order = range(nchunks) if in_order_commit else \
+            range(nchunks - 1, -1, -1)
+        for c in order:
+            body: List[Tuple[str, Callable]] = []
+            body.append(("chunk_seq_to_odd", lambda nxt, c=c:
+                         mk_seq_bump(c, nxt)))
+            mutate = [("mutate_chunk", lambda nxt, c=c, w=w, v=value:
+                       mk_write_word(c, w, v, nxt)) for w in range(words)]
+            publish = [("chunk_seq_to_even", lambda nxt, c=c:
+                        mk_seq_bump(c, nxt))]
+            if commit_fence:
+                body.extend(mutate + publish)
+            else:
+                body.extend(publish + mutate)
+            base = len(prog)
+            for k, (name, maker) in enumerate(body):
+                prog.append(maker(base + k + 1))
+                if dep == 0 and c == (0 if in_order_commit else nchunks - 1):
+                    spec_names.append(name)
+    if in_order_commit and commit_fence:
+        collapsed = tuple(
+            name for k, name in enumerate(spec_names)
+            if name != "mutate_chunk"
+            or (k == 0 or spec_names[k - 1] != "mutate_chunk"))
+        assert collapsed == CHUNK_WRITER_STEPS, (
+            f"model drifted from shm_native.CHUNK_WRITER_STEPS: {collapsed}")
+    return prog
+
+
+def _chunk_reader_program(nchunks: int, words: int) -> List[Callable]:
+    """Per-chunk bracketed consumer: for each chunk, retry-bracketed copy
+    under that chunk's seqlock; a completed bracket whose words mix two
+    episodes is a torn chunk."""
+    prog: List[Callable] = []
+    for c in range(nchunks):
+        pc_start = len(prog)
+
+        def read_before(sh, rg, c=c, pc_start=pc_start):
+            if sh[f"cs{c}"] & 1:
+                return [(sh, rg, pc_start)]  # odd: retry
+            return _r(sh, rg, pc_start + 1, before=sh[f"cs{c}"])
+
+        prog.append(read_before)
+
+        def mk_copy(c, w, next_pc):
+            def step(sh, rg):
+                return _r(sh, rg, next_pc, **{f"r{w}": sh[f"c{c}w{w}"]})
+            return step
+
+        for w in range(words):
+            prog.append(mk_copy(c, w, len(prog) + 1))
+
+        def read_after(sh, rg, c=c, pc_start=pc_start, end=pc_start + words + 2):
+            if sh[f"cs{c}"] != rg["before"]:
+                return [(sh, {}, pc_start)]  # changed: retry from scratch
+            vals = {rg[f"r{w}"] for w in range(words)}
+            if len(vals) > 1:
+                sh2 = dict(sh)
+                sh2["_bad"] = (f"torn chunk {c}: completed bracket mixes "
+                               f"episodes {sorted(vals)}")
+                return [(sh2, rg, end)]
+            return [(sh, {}, end)]
+
+        prog.append(read_after)
+    assert len(CHUNK_READER_STEPS) == 3  # spec sync (retry-bracketed copy)
+    return prog
+
+
+def _frontier_reader_program(nchunks: int, words: int) -> List[Callable]:
+    """Pipelined consumer chasing the commit frontier: once the LAST
+    chunk's seqlock shows d completed commits (even, >= 2), ascending
+    commit order guarantees every chunk already carries episode >= d —
+    in every word, even mid-write (older words are episode >= d, newer
+    ones are > d).  This is what lets bf_shm_win_probe's reader start
+    draining chunk 0 while the writer is still depositing chunk k."""
+    last = nchunks - 1
+
+    def observe_frontier(sh, rg):
+        s = sh[f"cs{last}"]
+        if (s & 1) or s < 2:
+            return [(sh, rg, 0)]  # spin until a commit of the last chunk
+        return _r(sh, rg, 1, d=s // 2)
+
+    prog: List[Callable] = [observe_frontier]
+    for c in range(nchunks):
+        def check_chunk(sh, rg, c=c, next_pc=len(prog) + 1):
+            lo = min(sh[f"c{c}w{w}"] for w in range(words))
+            if lo < rg["d"]:
+                sh2 = dict(sh)
+                sh2["_bad"] = (
+                    f"commit frontier violated: chunk {nchunks - 1} shows "
+                    f"episode {rg['d']} committed but chunk {c} still "
+                    f"carries episode {lo}")
+                return [(sh2, rg, next_pc)]
+            return [(sh, rg, next_pc)]
+
+        prog.append(check_chunk)
+    return prog
+
+
+def chunk_ring_model(nchunks: int = 2, deposits: int = 2, words: int = 2,
+                     in_order_commit: bool = CHUNK_COMMIT_IN_ORDER,
+                     commit_fence: bool = True,
+                     frontier_reader: bool = False) -> Model:
+    """The v2 chunk-ring slot under one depositing writer and one
+    consumer.  Defaults mirror ``slot_deposit`` (order asserted against
+    the shm_native protocol spec); ``commit_fence=False`` and
+    ``in_order_commit=False`` are the seeded-bug variants, caught by the
+    bracketed and frontier readers respectively."""
+    shared: Dict = {}
+    for c in range(nchunks):
+        shared[f"cs{c}"] = 0
+        for w in range(words):
+            shared[f"c{c}w{w}"] = 0
+    writer = _chunk_writer_program(nchunks, deposits, words,
+                                   in_order_commit, commit_fence)
+    reader = (_frontier_reader_program(nchunks, words) if frontier_reader
+              else _chunk_reader_program(nchunks, words))
+    return Model(name="chunk-ring", shared=shared,
+                 programs=[writer, reader])
+
+
+# ---------------------------------------------------------------------------
+# model 2c: drained-marker collect (protocol v2 — O(1) drain)
+# ---------------------------------------------------------------------------
+
+
+def drained_collect_model(deposits: int = 2,
+                          atomic_collect: bool = DRAINED_COLLECT_IS_ATOMIC
+                          ) -> Model:
+    """The v2 drain: collect stores ``drained = version`` under the slot
+    lock instead of zeroing the payload — a slot whose ``drained ==
+    version`` READS as zero, and an accumulating deposit into it degrades
+    to a copy (``add = drained != version``).  Mass conservation: with
+    one accumulating writer racing one collector, every deposited unit is
+    either collected or still logically in the slot.  The seeded bug
+    (``atomic_collect=False``) samples ``m``/``version`` OUTSIDE the
+    critical section and only takes the lock to store the marker — a
+    deposit landing in between is marked drained without ever being read."""
+    shared = {"lock": 0, "m": 0, "version": 0, "drained": 0, "collected": 0}
+
+    def logical(sh) -> int:
+        return 0 if sh["drained"] == sh["version"] else sh["m"]
+
+    writer: List[Callable] = []
+    for dep in range(deposits):
+        base = len(writer)
+
+        def w_acquire(sh, rg, nxt=base + 1):
+            if sh["lock"]:
+                return []
+            return _s(sh, rg, nxt, lock=1)
+
+        def w_deposit(sh, rg, nxt=base + 2):
+            # add = (drained != version): accumulate into a drained slot
+            # restarts from zero — the marker makes stale mass invisible
+            return _s(sh, rg, nxt, m=logical(sh) + 1,
+                      version=sh["version"] + 1)
+
+        def w_release(sh, rg, nxt=base + 3):
+            return _s(sh, rg, nxt, lock=0)
+
+        writer.extend([w_acquire, w_deposit, w_release])
+
+    if atomic_collect:
+        def c_acquire(sh, rg):
+            if sh["lock"]:
+                return []
+            return _s(sh, rg, 1, lock=1)
+
+        def c_drain(sh, rg):
+            return _s(sh, rg, 2, collected=sh["collected"] + logical(sh),
+                      drained=sh["version"])
+
+        def c_release(sh, rg):
+            return _s(sh, rg, 3, lock=0)
+
+        collector = [c_acquire, c_drain, c_release]
+    else:
+        # seeded bug: sample the logical mass lock-free, then only take
+        # the lock to store the drained marker
+        def c_sample(sh, rg):
+            return _r(sh, rg, 1, got=logical(sh))
+
+        def c_acquire(sh, rg):
+            if sh["lock"]:
+                return []
+            return _s(sh, rg, 2, lock=1)
+
+        def c_mark(sh, rg):
+            return _s(sh, rg, 3, collected=sh["collected"] + rg["got"],
+                      drained=sh["version"])
+
+        def c_release(sh, rg):
+            return _s(sh, rg, 4, lock=0)
+
+        collector = [c_sample, c_acquire, c_mark, c_release]
+
+    def conserved(sh) -> Optional[str]:
+        if sh["collected"] + logical(sh) != deposits:
+            return (f"lost deposit: {deposits} deposited but "
+                    f"collected={sh['collected']} + "
+                    f"logical-remaining={logical(sh)} "
+                    f"(drained marker {sh['drained']} vs version "
+                    f"{sh['version']})")
+        return None
+
+    return Model(name="drained-collect", shared=shared,
+                 programs=[writer, collector], final_check=conserved)
+
+
+# ---------------------------------------------------------------------------
 # model 3: sense-reversing barrier (lost wakeup)
 # ---------------------------------------------------------------------------
 
@@ -475,3 +737,32 @@ def _run_barrier(report: Report) -> None:
     for nranks, episodes in ((2, 2), (3, 2)):
         check_model(barrier_model(nranks=nranks, episodes=episodes),
                     report, rule="protocol.barrier-lost-wakeup")
+
+
+@registry.rule("protocol.chunk-ring-commit", "protocol",
+               "v2 chunk-ring deposits: no bracketed reader returns a "
+               "torn chunk and no frontier reader overtakes an ascending "
+               "commit")
+def _run_chunk_ring(report: Report) -> None:
+    # bracketed per-chunk consumer: torn-chunk safety (words > 1 so a
+    # half-written chunk is representable)
+    for nchunks, deposits in ((2, 2), (3, 1)):
+        check_model(
+            chunk_ring_model(nchunks=nchunks, deposits=deposits, words=2),
+            report, rule="protocol.chunk-ring-commit")
+    # pipelined frontier consumer: ascending commit order (one word per
+    # chunk — ordering, not tearing, is what this reader depends on)
+    for nchunks, deposits in ((2, 2), (3, 2)):
+        check_model(
+            chunk_ring_model(nchunks=nchunks, deposits=deposits, words=1,
+                             frontier_reader=True),
+            report, rule="protocol.chunk-ring-commit")
+
+
+@registry.rule("protocol.chunk-drained-mass-conservation", "protocol",
+               "the v2 O(1) drained-marker drain loses no concurrent "
+               "accumulating deposit")
+def _run_drained_collect(report: Report) -> None:
+    for deposits in (1, 2, 3):
+        check_model(drained_collect_model(deposits=deposits), report,
+                    rule="protocol.chunk-drained-mass-conservation")

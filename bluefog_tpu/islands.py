@@ -37,6 +37,7 @@ from __future__ import annotations
 import contextlib
 import itertools
 import os
+import sys
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import networkx as nx
@@ -98,6 +99,7 @@ class _IslandWindow:
         maxd = max((len(v) for v in self.slot_of.values()), default=0)
         self.self_tensor = np.array(tensor, copy=True)
         self.p_self = 1.0
+        self._scratch: Optional[np.ndarray] = None  # win_update staging
         self.shm = shm_native.make_window(
             ctx.job, name, ctx.rank, ctx.size, maxd,
             tensor.shape, tensor.dtype,
@@ -378,6 +380,7 @@ def win_create(tensor, name: str, zero_init: bool = False) -> bool:
     ctx.created_names.add(name)
     if meta is not None:
         ctx.win_fusion[name] = meta
+    _note_op("win_create", name)
     return True
 
 
@@ -403,6 +406,7 @@ def win_free(name: Optional[str] = None) -> bool:
         ctx.shm_job.barrier()  # name gone everywhere before any re-create
         ctx.created_names.discard(n)
         ctx.win_fusion.pop(n, None)
+        _note_op("win_free", n)
     return ok
 
 
@@ -418,14 +422,50 @@ def win_put(tensor, name: str, dst_weights: WeightDict = None) -> bool:
         # alias, don't copy: upstream the window aliases the user tensor's
         # memory, and the shm exposure below is already a stable snapshot
         win.self_tensor = t
-        win.shm.expose(t, win.p_self)
         targets = _check_dst(win, dst_weights)
+        scaled = _scaled_transport(win)
+        dual = getattr(win.shm, "put_dual", None) if scaled else None
+        exposed = False
         for d in targets:
             wgt = 1.0 if dst_weights is None else float(dst_weights[d])
-            payload = t if wgt == 1.0 else t * wgt
-            win.shm.write(d, win.slot_of[d][ctx.rank], payload,
-                          p=win.p_self * wgt, accumulate=False)
+            if dual is not None and not exposed:
+                # v2 transport: ONE read of t feeds both the exposed slot
+                # and the first destination's mailbox, chunk-interleaved
+                dual(d, win.slot_of[d][ctx.rank], t, p=win.p_self * wgt,
+                     accumulate=False, scale=wgt, expose_p=win.p_self)
+                exposed = True
+            elif scaled:
+                # the scale rides inside the deposit pass — no
+                # per-destination ``t * wgt`` temporary
+                win.shm.write(d, win.slot_of[d][ctx.rank], t,
+                              p=win.p_self * wgt, accumulate=False,
+                              scale=wgt)
+            else:
+                payload = t if wgt == 1.0 else t * wgt
+                win.shm.write(d, win.slot_of[d][ctx.rank], payload,
+                              p=win.p_self * wgt, accumulate=False)
+        if not exposed:
+            win.shm.expose(t, win.p_self)
+        _note_op("win_put", name)
     return True
+
+
+def _scaled_transport(win: _IslandWindow) -> bool:
+    """Whether the window's transport fuses a scale factor into the deposit
+    pass (protocol-v2 shm windows, float payloads only)."""
+    return (getattr(win.shm, "supports_scale", False)
+            and np.issubdtype(win.shm.dtype, np.floating))
+
+
+def _note_op(op: str, name: str) -> None:
+    """Record an island window op into the shared win-op log so
+    ``windows.record_win_ops()`` traces (and the verifier's epoch linter)
+    cover island-mode programs too.  Looked up via sys.modules: if
+    :mod:`bluefog_tpu.windows` was never imported, no recorder can be
+    active, and importing it here would pull jax into every island worker."""
+    _windows = sys.modules.get("bluefog_tpu.windows")
+    if _windows is not None:
+        _windows.note_win_op(op, name)
 
 
 def win_accumulate(tensor, name: str, dst_weights: WeightDict = None) -> bool:
@@ -438,11 +478,18 @@ def win_accumulate(tensor, name: str, dst_weights: WeightDict = None) -> bool:
         win = _win(name)
         t = _to_host(_island_pack(name, tensor)).astype(win.shm.dtype, copy=False)
         targets = _check_dst(win, dst_weights)
+        scaled = _scaled_transport(win)
         for d in targets:
             wgt = 1.0 if dst_weights is None else float(dst_weights[d])
-            payload = t if wgt == 1.0 else t * wgt
-            win.shm.write(d, win.slot_of[d][ctx.rank], payload,
-                          p=win.p_self * wgt, accumulate=True)
+            if scaled:
+                win.shm.write(d, win.slot_of[d][ctx.rank], t,
+                              p=win.p_self * wgt, accumulate=True,
+                              scale=wgt)
+            else:
+                payload = t if wgt == 1.0 else t * wgt
+                win.shm.write(d, win.slot_of[d][ctx.rank], payload,
+                              p=win.p_self * wgt, accumulate=True)
+        _note_op("win_accumulate", name)
     return True
 
 
@@ -461,13 +508,20 @@ def win_get(name: str, src_weights: WeightDict = None) -> bool:
                     f"in-neighbors of rank {ctx.rank} are {win.in_neighbors}"
                 )
         sources = win.in_neighbors if src_weights is None else src_weights
+        scaled = _scaled_transport(win)
         for s in sources:
             wgt = 1.0 if src_weights is None else float(src_weights[s])
             a, p, _ = win.shm.read_exposed(s)
             # writer-of-record is s: deposit and later read must agree on
             # which transport leg holds the slot (hierarchical routing)
-            win.shm.write(ctx.rank, win.slot_of[ctx.rank][s], a * wgt,
-                          p=p * wgt, accumulate=False, writer=s)
+            if scaled:
+                win.shm.write(ctx.rank, win.slot_of[ctx.rank][s], a,
+                              p=p * wgt, accumulate=False, writer=s,
+                              scale=wgt)
+            else:
+                win.shm.write(ctx.rank, win.slot_of[ctx.rank][s], a * wgt,
+                              p=p * wgt, accumulate=False, writer=s)
+        _note_op("win_get", name)
     return True
 
 
@@ -506,25 +560,86 @@ def win_update(
         sw, nw = _resolve_update_weights(win, self_weight, neighbor_weights)
         wdt = (win.shm.dtype if np.issubdtype(win.shm.dtype, np.inexact)
                else np.float64)
-        # preallocated-scratch combine: the naive expression
-        # ``acc + w * a.astype(wdt)`` allocates three payload-sized
-        # temporaries per neighbor (astype ALWAYS copies), which dominates
-        # the gossip round on a 1-core host.  One fused multiply into a
-        # reused scratch buffer + in-place add keeps it to two passes.
+        fused = (getattr(win.shm, "update_fused", None)
+                 if wdt == win.shm.dtype else None)
+        if fused is not None:
+            # v2 transport: the entire update — self-scale, every weighted
+            # neighbor combine, the atomic drain, AND the expose republish
+            # — is one native chunked sweep; the per-chunk partial stays
+            # cache-resident across sub-passes, so the round does ~one
+            # traversal per payload instead of four.
+            self_data = np.ascontiguousarray(win.self_tensor, dtype=wdt)
+            slots = [win.slot_of[ctx.rank][s] for s in win.in_neighbors]
+            wts = [nw[s] for s in win.in_neighbors]
+            view_fn = getattr(win.shm, "exposed_view", None)
+            if view_fn is not None:
+                # in-place form: the combine's destination IS the exposed
+                # payload (reference windows alias tensor memory — bf's
+                # win_update writes the buffer neighbors read), so the
+                # republish copy disappears entirely.  The returned tensor
+                # is a view over an independent mapping of those pages and
+                # stays readable after win_free unmaps the window.
+                p_acc = fused(
+                    slots, wts, self_data, sw, win.p_self, None,
+                    collect=reset, expose=2 if ctx.associated_p else 1,
+                )
+                win.self_tensor = view_fn()
+            else:
+                if (win._scratch is None or win._scratch.dtype != wdt
+                        or win._scratch.shape != win.self_tensor.shape):
+                    win._scratch = np.empty(win.self_tensor.shape, dtype=wdt)
+                out_buf = win._scratch
+                p_acc = fused(
+                    slots, wts, self_data, sw, win.p_self, out_buf,
+                    collect=reset, expose=2 if ctx.associated_p else 1,
+                )
+                # the buffer IS the new window tensor; a subsequent
+                # win_update reads it back as self_data, which the native
+                # sweep handles alias-safely
+                win.self_tensor = out_buf
+            if ctx.associated_p:
+                win.p_self = float(p_acc)
+            _note_op("win_update", name)
+            out = win.self_tensor
+            out = np.array(out, copy=True) if clone else out
+            return _island_unpack(name, out)
         acc = np.multiply(win.self_tensor, sw, dtype=wdt)
-        scratch = np.empty_like(acc)
         p_acc = sw * win.p_self
-        for s in win.in_neighbors:
-            a, p, _ = win.shm.read(
-                win.slot_of[ctx.rank][s], collect=reset, src=s
-            )
-            np.multiply(a, nw[s], out=scratch, casting="unsafe")
-            acc += scratch
-            p_acc = p_acc + nw[s] * p
+        combine = (getattr(win.shm, "combine", None)
+                   if wdt == win.shm.dtype else None)
+        if combine is not None:
+            # v2 shm transport: the weighted combine is fused into ONE
+            # native pass per neighbor under the slot lock — the slot
+            # payload is never materialized on the Python side, and
+            # collect (reset) happens in the same critical section.
+            for s in win.in_neighbors:
+                p, _ = combine(win.slot_of[ctx.rank][s], acc, nw[s],
+                               collect=reset, src=s)
+                p_acc = p_acc + nw[s] * p
+        else:
+            # preallocated-scratch combine for the other transports: the
+            # naive expression ``acc + w * a.astype(wdt)`` allocates three
+            # payload-sized temporaries per neighbor (astype ALWAYS
+            # copies), which dominates the gossip round on a 1-core host.
+            # One fused multiply into a persistent scratch buffer + an
+            # in-place add keeps it to two passes with zero allocations
+            # after the first call.
+            if (win._scratch is None or win._scratch.shape != acc.shape
+                    or win._scratch.dtype != acc.dtype):
+                win._scratch = np.empty_like(acc)
+            scratch = win._scratch
+            for s in win.in_neighbors:
+                a, p, _ = win.shm.read(
+                    win.slot_of[ctx.rank][s], collect=reset, src=s
+                )
+                np.multiply(a, nw[s], out=scratch, casting="unsafe")
+                np.add(acc, scratch, out=acc)
+                p_acc = p_acc + nw[s] * p
         win.self_tensor = acc.astype(win.shm.dtype, copy=False)
         if ctx.associated_p:
             win.p_self = float(p_acc)
         win.shm.expose(win.self_tensor, win.p_self)
+        _note_op("win_update", name)
         out = win.self_tensor
         out = np.array(out, copy=True) if clone else out
         return _island_unpack(name, out)

@@ -123,7 +123,13 @@ def _declare_abi(lib: ctypes.CDLL) -> None:
         ]
         lib.bf_loader_destroy.restype = None
         lib.bf_loader_destroy.argtypes = [ctypes.c_void_p]
-        # shm mailbox ABI (async island window transport)
+        # shm mailbox ABI (async island window transport, protocol v2:
+        # chunk-ring seqlocks, drained markers, fused scale/combine).
+        # Declaring the version sentinel FIRST makes loading a stale v1 .so
+        # raise AttributeError here, which get_lib() answers with a forced
+        # rebuild — the ABI below is not call-compatible with v1.
+        lib.bf_shm_abi_version.restype = ctypes.c_int32
+        lib.bf_shm_abi_version.argtypes = []
         lib.bf_shm_job_create.restype = ctypes.c_void_p
         lib.bf_shm_job_create.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
@@ -140,17 +146,45 @@ def _declare_abi(lib: ctypes.CDLL) -> None:
         lib.bf_shm_win_create.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int64,  # chunk_bytes
         ]
         lib.bf_shm_win_write.restype = None
         lib.bf_shm_win_write.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_double, ctypes.c_int32,
+            ctypes.c_double,  # scale
         ]
         lib.bf_shm_win_read.restype = ctypes.c_int64
         lib.bf_shm_win_read.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_double), ctypes.c_int32,
         ]
+        lib.bf_shm_win_combine.restype = ctypes.c_int64
+        lib.bf_shm_win_combine.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_double, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.bf_shm_win_probe.restype = ctypes.c_int32
+        lib.bf_shm_win_probe.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int64,
+        ]
+        lib.bf_shm_win_put_dual.restype = None
+        lib.bf_shm_win_put_dual.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_double, ctypes.c_int32,
+            ctypes.c_double, ctypes.c_double,
+        ]
+        lib.bf_shm_win_update_fused.restype = ctypes.c_double
+        lib.bf_shm_win_update_fused.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double),
+            ctypes.c_void_p, ctypes.c_double, ctypes.c_double,
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+        ]
+        lib.bf_shm_win_exposed_offset.restype = ctypes.c_int64
+        lib.bf_shm_win_exposed_offset.argtypes = [ctypes.c_void_p]
         lib.bf_shm_win_reset.restype = None
         lib.bf_shm_win_reset.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.bf_shm_win_expose.restype = None

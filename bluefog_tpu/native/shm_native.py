@@ -3,11 +3,17 @@
 Process-to-process transport for the asynchronous island window ops
 (:mod:`bluefog_tpu.islands`) — the TPU-native sibling of the reference's
 passive-target MPI RMA windows (``MPI_Win_create/Put/Accumulate/lock`` in
-``bluefog/common/mpi_controller.cc`` [U]).  The native path is a seqlock
-mailbox in POSIX shm (readers wait-free, writers per-slot spinlocked, an
-atomic read+zero ``collect`` for mass-conserving push-sum).  The fallback
-implements the same interface over an mmap'd file with ``fcntl.lockf``
-byte-range locks — slower, zero native deps, used when the .so is absent.
+``bluefog/common/mpi_controller.cc`` [U]).  The native path is a chunked
+seqlock mailbox in POSIX shm (protocol v2): each slot's payload is divided
+into ``chunk_bytes`` chunks, each guarded by its own seqlock and committed
+in ascending order, so a pipelined consumer can chase the commit frontier;
+collect/reset drain via an O(1) ``drained`` version marker instead of a
+zeroing pass; deposits fuse an optional ``scale`` into the copy loop and
+``combine`` fuses the reader-side ``acc += weight * payload`` — the three
+sequential payload traversals of the v1 protocol collapse into ~one.  The
+fallback implements the same interface over an mmap'd file with
+``fcntl.lockf`` byte-range locks — slower, zero native deps, used when the
+.so is absent.
 
 Both paths share slot geometry: per window, ``nranks`` exposed slots (the
 owner-published tensor ``win_get`` reads) followed by ``nranks × maxd``
@@ -59,10 +65,67 @@ SEQLOCK_READER_STEPS = (
     "read_seq_after_retry_if_changed",
 )
 
-#: bf_shm_win_read(collect=1): the read AND the zero happen inside ONE
-#: slot_write critical section — the push-sum mass-conservation primitive
-#: (a deposit can never land between the read and the zero).
+#: bf_shm_win_read(collect=1): the read AND the drain happen inside ONE
+#: critical section — the push-sum mass-conservation primitive (a deposit
+#: can never land between the read and the drain marker).
 COLLECT_IS_ATOMIC = True
+
+#: slot_deposit() in shm_mailbox.cc, per chunk: chunk_seq -> odd, mutate
+#: the chunk, release-fence, chunk_seq -> even.  The release fence before
+#: the even publish is what makes an even chunk_seq imply the chunk bytes
+#: are globally visible — the verifier's chunk-ring model seeds a variant
+#: with the fence dropped and must catch it.
+CHUNK_WRITER_STEPS = (
+    "chunk_seq_to_odd",
+    "mutate_chunk",
+    "chunk_seq_to_even",
+)
+
+#: Per-chunk consumer bracket (the pipelined drain): same retry discipline
+#: as the whole-slot reader, applied to one chunk_seq.
+CHUNK_READER_STEPS = (
+    "read_chunk_seq_before_retry_if_odd",
+    "copy_chunk",
+    "read_chunk_seq_after_retry_if_changed",
+)
+
+#: slot_deposit() commits chunks in ASCENDING index order: observing chunk
+#: c committed at episode E implies every chunk < c is committed at >= E
+#: (the frontier invariant a pipelined consumer relies on).  The model
+#: checks the reversed-order variant loses this ("reordered chunk commit").
+CHUNK_COMMIT_IN_ORDER = True
+
+#: collect/reset drain by storing ``drained = version`` (an O(1) marker;
+#: a drained slot READS as zeros by contract) in the same critical section
+#: as the copy-out — no memset pass, and still no window for a concurrent
+#: accumulate to be marked drained without having been read (model-checked
+#: "no lost deposit").
+DRAINED_COLLECT_IS_ATOMIC = True
+
+#: Chunk size of the v2 transport.  64 KiB x pipeline_depth 4 keeps the
+#: probe ring L2-resident on common parts, which is where the measured
+#: pipelined bandwidth peaks (see benchmarks/gossip_bandwidth.py's sweep).
+DEFAULT_CHUNK_BYTES = 64 * 1024
+DEFAULT_PIPELINE_DEPTH = 4
+
+
+def chunk_bytes() -> int:
+    """Configured chunk size (``BLUEFOG_SHM_CHUNK_BYTES`` or the default)."""
+    try:
+        v = int(os.environ.get("BLUEFOG_SHM_CHUNK_BYTES", ""))
+    except ValueError:
+        return DEFAULT_CHUNK_BYTES
+    return v if v > 0 else DEFAULT_CHUNK_BYTES
+
+
+def pipeline_depth() -> int:
+    """Ring depth for the pipelined self-edge probe
+    (``BLUEFOG_SHM_PIPELINE_DEPTH`` or the default)."""
+    try:
+        v = int(os.environ.get("BLUEFOG_SHM_PIPELINE_DEPTH", ""))
+    except ValueError:
+        return DEFAULT_PIPELINE_DEPTH
+    return v if v > 0 else DEFAULT_PIPELINE_DEPTH
 
 #: bf_shm_job_barrier(): sense-reversing — the last arriver must reset
 #: ``arrived`` BEFORE bumping ``generation``; the opposite order loses the
@@ -122,10 +185,21 @@ class NativeShmJob:
 
 
 class NativeShmWindow:
-    """One named window: exposed slots + per-in-neighbor mailbox slots."""
+    """One named window: exposed slots + per-in-neighbor mailbox slots.
+
+    Protocol v2: payloads stream through per-chunk seqlocks (ascending
+    commit order), ``write`` fuses a ``scale`` factor into the deposit
+    pass, ``combine`` fuses the weighted read-side accumulation, and
+    collect/reset drain via the O(1) ``drained`` marker.
+    """
+
+    #: islands.py keys off this to route scaled deposits / fused combines
+    #: through the transport instead of staging temporaries.
+    supports_scale = True
 
     def __init__(self, job: str, name: str, rank: int, nranks: int,
-                 maxd: int, shape: Tuple[int, ...], dtype):
+                 maxd: int, shape: Tuple[int, ...], dtype,
+                 chunk: Optional[int] = None):
         lib = get_lib()
         if lib is None:
             raise RuntimeError("native library unavailable")
@@ -135,19 +209,28 @@ class NativeShmWindow:
         self.dtype = np.dtype(dtype)
         self.nbytes = int(np.prod(self.shape, dtype=np.int64)) * self.dtype.itemsize
         self._code = _DTYPE_CODES.get(self.dtype, 0)
+        self.chunk_bytes = int(chunk) if chunk else chunk_bytes()
+        self.nchunks = max(1, -(-self.nbytes // self.chunk_bytes))
+        self.pipeline_depth = min(pipeline_depth(), self.nchunks)
         self._name = seg_name(job, f"win_{name}")
         self._h = lib.bf_shm_win_create(
             self._name.encode(), rank, nranks, max(maxd, 1), self.nbytes,
-            self._code,
+            self._code, self.chunk_bytes,
         )
         if not self._h:
             raise RuntimeError(f"could not create shm window {self._name}")
+        self._exposed_view: Optional[np.ndarray] = None
 
     def write(self, dst: int, slot: int, array, p: float = 1.0,
-              accumulate: bool = False, writer=None) -> None:
+              accumulate: bool = False, writer=None,
+              scale: float = 1.0) -> None:
         del writer  # single-transport: routing is the RoutedWindow's job
-        if accumulate and self._code == 0:
-            raise TypeError(f"accumulate unsupported for dtype {self.dtype}")
+        if self._code == 0:
+            if accumulate:
+                raise TypeError(
+                    f"accumulate unsupported for dtype {self.dtype}")
+            if scale != 1.0:
+                raise TypeError(f"scale unsupported for dtype {self.dtype}")
         a = _as_contiguous(array, self.dtype)
         if a.nbytes != self.nbytes:
             raise ValueError(
@@ -157,18 +240,155 @@ class NativeShmWindow:
         self._lib.bf_shm_win_write(
             self._h, int(dst), int(slot),
             a.ctypes.data_as(ctypes.c_void_p), float(p),
-            1 if accumulate else 0,
+            1 if accumulate else 0, float(scale),
         )
 
-    def read(self, slot: int, collect: bool = False, src=None):
+    def read(self, slot: int, collect: bool = False, src=None, out=None):
         del src
-        out = np.empty(self.shape, dtype=self.dtype)
+        if out is None:
+            out = np.empty(self.shape, dtype=self.dtype)
+        elif (out.dtype != self.dtype or out.nbytes != self.nbytes
+              or not out.flags["C_CONTIGUOUS"]):
+            raise ValueError(
+                f"read out= must be C-contiguous {self.dtype} of "
+                f"{self.nbytes} bytes"
+            )
         p = ctypes.c_double(0.0)
         version = self._lib.bf_shm_win_read(
             self._h, int(slot), out.ctypes.data_as(ctypes.c_void_p),
             ctypes.byref(p), 1 if collect else 0,
         )
         return out, p.value, int(version)
+
+    def combine(self, slot: int, acc: np.ndarray, weight: float = 1.0,
+                collect: bool = False, src=None):
+        """Fused ``acc += weight * slot_payload`` in one native pass under
+        the slot lock (a drained slot contributes nothing).  ``collect``
+        drains in the same critical section — atomic with concurrent
+        accumulating writers.  Returns ``(p, version)``."""
+        del src
+        if self._code == 0:
+            raise TypeError(f"combine unsupported for dtype {self.dtype}")
+        if (acc.dtype != self.dtype or acc.nbytes != self.nbytes
+                or not acc.flags["C_CONTIGUOUS"]):
+            raise ValueError(
+                f"combine acc must be C-contiguous {self.dtype} of "
+                f"{self.nbytes} bytes"
+            )
+        p = ctypes.c_double(0.0)
+        version = self._lib.bf_shm_win_combine(
+            self._h, int(slot), acc.ctypes.data_as(ctypes.c_void_p),
+            float(weight), 1 if collect else 0, ctypes.byref(p),
+        )
+        return p.value, int(version)
+
+    def put_dual(self, dst: int, slot: int, array, p: float = 1.0,
+                 accumulate: bool = False, scale: float = 1.0,
+                 expose_p: float = 1.0) -> None:
+        """Fused expose + deposit: one read of ``array`` feeds both my
+        exposed slot and the mailbox slot at ``(dst, slot)``,
+        chunk-interleaved (the win_put fast path — replaces two full
+        payload passes with one)."""
+        if self._code == 0:
+            raise TypeError(f"put_dual unsupported for dtype {self.dtype}")
+        a = _as_contiguous(array, self.dtype)
+        if a.nbytes != self.nbytes:
+            raise ValueError(
+                f"put_dual payload has {a.nbytes} bytes but window "
+                f"{self._name} expects {self.nbytes}"
+            )
+        self._lib.bf_shm_win_put_dual(
+            self._h, int(dst), int(slot),
+            a.ctypes.data_as(ctypes.c_void_p), float(p),
+            1 if accumulate else 0, float(scale), float(expose_p),
+        )
+
+    def update_fused(self, slots, weights, self_data: np.ndarray,
+                     self_weight: float, self_p: float,
+                     out: Optional[np.ndarray],
+                     collect: bool = False, expose: int = 0) -> float:
+        """Whole win_update in one native sweep:
+        ``out = self_weight * self_data + Σ weights[i] * slot_i`` with the
+        per-chunk partial cache-resident across sub-passes, optional atomic
+        drain of every slot, and optional chunk-interleaved republish of
+        ``out`` as the exposed tensor (``expose``: 0 off, 1 with
+        p = self_p, 2 with p = the combined mass).  ``out=None`` selects
+        the in-place form: the destination is the exposed payload itself
+        (read back through :meth:`exposed_view`), which drops the separate
+        result buffer AND the republish copy — ``expose`` is then implied
+        (forced to 1 if 0).  Returns the combined mass."""
+        if self._code == 0:
+            raise TypeError(
+                f"update_fused unsupported for dtype {self.dtype}")
+        checks = [("self_data", self_data)]
+        if out is not None:
+            checks.append(("out", out))
+        for name, a in checks:
+            if (a.dtype != self.dtype or a.nbytes != self.nbytes
+                    or not a.flags["C_CONTIGUOUS"]):
+                raise ValueError(
+                    f"update_fused {name} must be C-contiguous "
+                    f"{self.dtype} of {self.nbytes} bytes"
+                )
+        n = len(slots)
+        if n != len(weights) or n > 64:
+            raise ValueError("update_fused: bad slots/weights")
+        c_slots = (ctypes.c_int64 * n)(*[int(s) for s in slots])
+        c_w = (ctypes.c_double * n)(*[float(w) for w in weights])
+        out_ptr = (None if out is None
+                   else out.ctypes.data_as(ctypes.c_void_p))
+        return float(self._lib.bf_shm_win_update_fused(
+            self._h, n, c_slots, c_w,
+            self_data.ctypes.data_as(ctypes.c_void_p), float(self_weight),
+            float(self_p), out_ptr,
+            1 if collect else 0, int(expose),
+        ))
+
+    def exposed_view(self) -> np.ndarray:
+        """A numpy view of my exposed payload, backed by an INDEPENDENT
+        ``mmap`` of the same shm pages (MAP_SHARED ⇒ coherent with the
+        native mapping).  Because the view owns its own mapping, arrays
+        returned to callers stay readable after :meth:`close` unmaps the
+        native segment — the pages live until the last mapping drops.
+        Combined with ``update_fused(out=None)`` this makes the island
+        ``self_tensor`` the window buffer itself, the reference's
+        win_update semantics, with zero extra copies."""
+        if self._exposed_view is None:
+            off = int(self._lib.bf_shm_win_exposed_offset(self._h))
+            page = mmap.PAGESIZE
+            base = off & ~(page - 1)
+            delta = off - base
+            fd = os.open("/dev/shm" + self._name, os.O_RDWR)
+            try:
+                mm = mmap.mmap(fd, delta + self.nbytes, offset=base)
+            finally:
+                os.close(fd)
+            flat = np.frombuffer(
+                mm, dtype=self.dtype,
+                count=self.nbytes // self.dtype.itemsize, offset=delta)
+            self._exposed_view = flat.reshape(self.shape)
+        return self._exposed_view
+
+    def probe(self, src: np.ndarray, dst: np.ndarray, slot: int = 0,
+              ring_depth: Optional[int] = None) -> None:
+        """Pipelined self-edge streaming pass: ``src`` flows to ``dst``
+        through a bounded cache-resident ring of ``ring_depth`` chunk
+        slots of my own mailbox ``slot``, with the full per-chunk seqlock
+        protocol on both legs.  One call = one complete payload roundtrip
+        (the protocol-ceiling benchmark primitive); the slot is left
+        drained."""
+        for a in (src, dst):
+            if a.nbytes != self.nbytes or not a.flags["C_CONTIGUOUS"]:
+                raise ValueError(
+                    f"probe buffers must be C-contiguous, {self.nbytes} bytes"
+                )
+        depth = int(ring_depth) if ring_depth else self.pipeline_depth
+        rc = self._lib.bf_shm_win_probe(
+            self._h, int(slot), src.ctypes.data_as(ctypes.c_void_p),
+            dst.ctypes.data_as(ctypes.c_void_p), depth,
+        )
+        if rc != 0:
+            raise RuntimeError("probe reader bracket failed")
 
     def read_version(self, slot: int, src=None) -> int:
         del src
@@ -230,6 +450,119 @@ def _unlink_name(name: str) -> None:
             os.unlink(os.path.join(d, name[1:]))
         except OSError:
             pass
+
+
+# ---------------------------------------------------------------------------
+# Python mirror of the chunk-ring slot protocol (tests / fault injection)
+# ---------------------------------------------------------------------------
+
+
+class ChunkRingMirror:
+    """In-process mirror of one chunk-ring slot's state machine.
+
+    Replays the exact v2 protocol steps (``CHUNK_WRITER_STEPS`` /
+    ``CHUNK_READER_STEPS``) over numpy state so tests can freeze a writer
+    MID-DEPOSIT — something the native path never exposes — and assert the
+    reader-side retry discipline: a bracketed read must refuse to return
+    while ``wseq`` is odd or changes across the copy.  Byte-level chunk
+    math mirrors the native layout (last chunk may be short).
+    """
+
+    def __init__(self, nbytes: int, chunk: Optional[int] = None):
+        self.nbytes = int(nbytes)
+        self.chunk_bytes = int(chunk) if chunk else chunk_bytes()
+        self.nchunks = max(1, -(-self.nbytes // self.chunk_bytes))
+        self.payload = np.zeros(self.nbytes, dtype=np.uint8)
+        self.chunk_seq = np.zeros(self.nchunks, dtype=np.uint64)
+        self.wseq = 0
+        self.version = 0
+        self.drained = 0
+        self.p = 0.0
+        self._pending = None  # (data, p, next_chunk) of a frozen deposit
+
+    def _chunk_slice(self, c: int) -> slice:
+        lo = c * self.chunk_bytes
+        return slice(lo, min(lo + self.chunk_bytes, self.nbytes))
+
+    def _commit_chunk(self, data: bytes, c: int) -> None:
+        sl = self._chunk_slice(c)
+        self.chunk_seq[c] += 1  # odd: chunk in flux
+        self.payload[sl] = np.frombuffer(data[sl], dtype=np.uint8)
+        self.chunk_seq[c] += 1  # even: committed (release in native code)
+
+    def write(self, data: bytes, p: float = 1.0) -> None:
+        """Full deposit: ascending in-order chunk commits under odd wseq."""
+        assert self._pending is None, "complete the torn write first"
+        assert len(data) == self.nbytes
+        self.wseq += 1
+        for c in range(self.nchunks):
+            self._commit_chunk(data, c)
+        self.version += 1
+        self.p = p
+        self.wseq += 1
+
+    def begin_torn_write(self, data: bytes, p: float = 1.0,
+                         tear_at: int = 0) -> None:
+        """Start a deposit and FREEZE it mid-protocol: chunks before
+        ``tear_at`` are committed, chunk ``tear_at`` is left odd with only
+        half its bytes stored, and ``wseq`` stays odd — the state a reader
+        observes when a writer is preempted mid-copy."""
+        assert self._pending is None
+        assert len(data) == self.nbytes
+        assert 0 <= tear_at < self.nchunks
+        self.wseq += 1
+        for c in range(tear_at):
+            self._commit_chunk(data, c)
+        sl = self._chunk_slice(tear_at)
+        half = sl.start + max(1, (sl.stop - sl.start) // 2)
+        self.chunk_seq[tear_at] += 1  # odd, and it stays odd
+        self.payload[sl.start:half] = np.frombuffer(
+            data[sl.start:half], dtype=np.uint8)
+        self._pending = (data, p, tear_at)
+
+    def complete_write(self) -> None:
+        """Finish the frozen deposit (writer resumes and publishes)."""
+        assert self._pending is not None
+        data, p, tear_at = self._pending
+        sl = self._chunk_slice(tear_at)
+        self.payload[sl] = np.frombuffer(data[sl], dtype=np.uint8)
+        self.chunk_seq[tear_at] += 1  # even
+        for c in range(tear_at + 1, self.nchunks):
+            self._commit_chunk(data, c)
+        self.version += 1
+        self.p = p
+        self.wseq += 1
+        self._pending = None
+
+    def read(self, retries: int = 64):
+        """Whole-slot bracketed read: retry while ``wseq`` is odd or moves
+        across the copy.  Raises TimeoutError once the retry budget is
+        exhausted (a frozen torn writer never publishes)."""
+        for _ in range(retries):
+            before = self.wseq
+            if before & 1:
+                continue
+            out = self.payload.copy()
+            empty = self.drained == self.version
+            p = 0.0 if empty else self.p
+            if self.wseq == before:
+                if empty:
+                    out[:] = 0
+                return bytes(out), p, self.version
+        raise TimeoutError("reader retry budget exhausted (torn writer)")
+
+    def read_chunk(self, c: int, retries: int = 64) -> bytes:
+        """Per-chunk bracketed read (the pipelined consumer's unit)."""
+        sl = self._chunk_slice(c)
+        for _ in range(retries):
+            before = int(self.chunk_seq[c])
+            if before & 1:
+                continue
+            out = bytes(self.payload[sl])
+            if int(self.chunk_seq[c]) == before:
+                return out
+        raise TimeoutError(
+            f"chunk {c} retry budget exhausted (torn writer)")
 
 
 # ---------------------------------------------------------------------------
@@ -314,19 +647,28 @@ class FallbackShmJob:
 
 
 class FallbackShmWindow:
-    """Same slot geometry as the native window; every op takes the slot's
-    exclusive lock (no seqlock — simplicity over read throughput)."""
+    """Same slot geometry and op surface as the native window (including
+    scaled writes and fused ``combine``); every op takes the slot's
+    exclusive lock (no seqlock or chunking — simplicity over throughput;
+    the chunk attributes exist only so benchmark/metadata consumers see a
+    uniform interface)."""
 
     _HDR = 16  # per-slot: [version u64][p f64]
 
+    supports_scale = True
+
     def __init__(self, job: str, name: str, rank: int, nranks: int,
-                 maxd: int, shape: Tuple[int, ...], dtype):
+                 maxd: int, shape: Tuple[int, ...], dtype,
+                 chunk: Optional[int] = None):
         self.rank = rank
         self.nranks = nranks
         self.maxd = max(maxd, 1)
         self.shape = tuple(shape)
         self.dtype = np.dtype(dtype)
         self.nbytes = int(np.prod(self.shape, dtype=np.int64)) * self.dtype.itemsize
+        self.chunk_bytes = int(chunk) if chunk else chunk_bytes()
+        self.nchunks = max(1, -(-self.nbytes // self.chunk_bytes))
+        self.pipeline_depth = min(pipeline_depth(), self.nchunks)
         self._stride = self._HDR + ((self.nbytes + 63) // 64) * 64
         nslots = nranks + nranks * self.maxd
         path = os.path.join(_FALLBACK_DIR, seg_name(job, f"win_{name}")[1:])
@@ -357,13 +699,20 @@ class FallbackShmWindow:
         self._seg.unlock(self._off(index), self._stride)
 
     def write(self, dst: int, slot: int, array, p: float = 1.0,
-              accumulate: bool = False, writer=None) -> None:
+              accumulate: bool = False, writer=None,
+              scale: float = 1.0) -> None:
         del writer
-        if accumulate and self.dtype not in _DTYPE_CODES:
-            # same contract as the native path: accumulate needs a float
-            # payload (raw dtypes are opaque bytes)
-            raise TypeError(f"accumulate unsupported for dtype {self.dtype}")
+        if self.dtype not in _DTYPE_CODES:
+            # same contract as the native path: accumulate/scale need a
+            # float payload (raw dtypes are opaque bytes)
+            if accumulate:
+                raise TypeError(
+                    f"accumulate unsupported for dtype {self.dtype}")
+            if scale != 1.0:
+                raise TypeError(f"scale unsupported for dtype {self.dtype}")
         a = _as_contiguous(array, self.dtype)
+        if scale != 1.0:
+            a = a * np.asarray(scale, dtype=self.dtype)
         idx = self._mail_index(dst, slot)
         off = self._locked(idx)
         try:
@@ -378,7 +727,7 @@ class FallbackShmWindow:
         finally:
             self._unlock(idx)
 
-    def read(self, slot: int, collect: bool = False, src=None):
+    def read(self, slot: int, collect: bool = False, src=None, out=None):
         del src
         idx = self._mail_index(self.rank, slot)
         off = self._locked(idx)
@@ -392,7 +741,78 @@ class FallbackShmWindow:
                 struct.pack_into("<Qd", mm, off, version, 0.0)
         finally:
             self._unlock(idx)
+        if out is not None:
+            np.copyto(out, a)
+            a = out
         return a, p, version
+
+    def combine(self, slot: int, acc: np.ndarray, weight: float = 1.0,
+                collect: bool = False, src=None):
+        """acc += weight * payload under the slot lock; returns (p,
+        version).  Interface parity with the native fused combine (here it
+        is two numpy passes over a view — no temporaries, but no fusion)."""
+        del src
+        if self.dtype not in _DTYPE_CODES:
+            raise TypeError(f"combine unsupported for dtype {self.dtype}")
+        idx = self._mail_index(self.rank, slot)
+        off = self._locked(idx)
+        try:
+            mm = self._seg._mm
+            version, p = struct.unpack_from("<Qd", mm, off)
+            view = np.frombuffer(
+                mm, dtype=self.dtype,
+                count=self.nbytes // self.dtype.itemsize,
+                offset=off + self._HDR,
+            ).reshape(self.shape)
+            flat_acc = acc.reshape(self.shape)
+            flat_acc += np.asarray(weight, dtype=self.dtype) * view
+            if collect:
+                mm[off + self._HDR:off + self._HDR + self.nbytes] = (
+                    b"\x00" * self.nbytes
+                )
+                struct.pack_into("<Qd", mm, off, version, 0.0)
+        finally:
+            self._unlock(idx)
+        return p, version
+
+    def put_dual(self, dst: int, slot: int, array, p: float = 1.0,
+                 accumulate: bool = False, scale: float = 1.0,
+                 expose_p: float = 1.0) -> None:
+        """Interface parity with the native fused op: expose + deposit as
+        two plain locked passes (nothing to fuse without chunking)."""
+        if self.dtype not in _DTYPE_CODES:
+            raise TypeError(f"put_dual unsupported for dtype {self.dtype}")
+        self.expose(array, expose_p)
+        self.write(dst, slot, array, p=p, accumulate=accumulate, scale=scale)
+
+    def update_fused(self, slots, weights, self_data: np.ndarray,
+                     self_weight: float, self_p: float, out: np.ndarray,
+                     collect: bool = False, expose: int = 0) -> float:
+        """Interface parity with the native fused sweep, composed from the
+        per-slot combine (same drain atomicity per slot, no cross-slot
+        fusion)."""
+        if self.dtype not in _DTYPE_CODES:
+            raise TypeError(
+                f"update_fused unsupported for dtype {self.dtype}")
+        flat = out.reshape(-1)
+        np.multiply(self_data.reshape(-1),
+                    np.asarray(self_weight, dtype=self.dtype), out=flat)
+        p_acc = self_weight * self_p
+        for s, w in zip(slots, weights):
+            p, _ = self.combine(s, out, w, collect=collect)
+            p_acc += w * p
+        if expose:
+            self.expose(out, p_acc if expose == 2 else self_p)
+        return float(p_acc)
+
+    def probe(self, src: np.ndarray, dst: np.ndarray, slot: int = 0,
+              ring_depth: Optional[int] = None) -> None:
+        """Self-edge roundtrip for the protocol-ceiling benchmark: a plain
+        locked write + read (the fallback has no chunk ring to pipeline)."""
+        del ring_depth
+        self.write(self.rank, slot, src)
+        a, _, _ = self.read(slot, collect=True)
+        np.copyto(dst.reshape(self.shape), a)
 
     def read_version(self, slot: int, src=None) -> int:
         del src
@@ -461,10 +881,12 @@ def make_shm_job(job: str, rank: int, nranks: int):
 
 
 def make_shm_window(job: str, name: str, rank: int, nranks: int, maxd: int,
-                    shape, dtype):
+                    shape, dtype, chunk: Optional[int] = None):
     if get_lib() is not None and not _force_fallback():
-        return NativeShmWindow(job, name, rank, nranks, maxd, shape, dtype)
-    return FallbackShmWindow(job, name, rank, nranks, maxd, shape, dtype)
+        return NativeShmWindow(job, name, rank, nranks, maxd, shape, dtype,
+                               chunk=chunk)
+    return FallbackShmWindow(job, name, rank, nranks, maxd, shape, dtype,
+                             chunk=chunk)
 
 
 def make_job(job: str, rank: int, nranks: int):

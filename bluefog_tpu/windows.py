@@ -71,6 +71,7 @@ __all__ = [
     "turn_on_win_ops_with_associated_p",
     "turn_off_win_ops_with_associated_p",
     "record_win_ops",
+    "note_win_op",
 ]
 
 WeightsArg = Union[None, Sequence[Dict[int, float]]]
@@ -101,6 +102,15 @@ def record_win_ops():
 def _log_op(op: str, name: Optional[str]) -> None:
     if _OP_LOG is not None:
         _OP_LOG.append((op, "*" if name is None else name))
+
+
+def note_win_op(op: str, name: Optional[str]) -> None:
+    """Record a window op from OUTSIDE this module into the active
+    ``record_win_ops()`` trace (no-op when recording is off).  The island
+    runtime (:mod:`bluefog_tpu.islands`) calls this from its win ops so a
+    single recorder covers both execution modes — the epoch linter lints
+    island-mode programs with the same rules as the SPMD emulation."""
+    _log_op(op, name)
 
 
 class _Window:

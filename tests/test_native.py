@@ -154,3 +154,184 @@ def test_data_loader_zero_copy_view():
             assert v.base is not None  # a view into the ring, not a copy
         second = dl.next()
     assert not np.array_equal(second, first)  # released buffer moved on
+
+
+# ---------------------------------------------------------------------------
+# shm mailbox protocol v2: chunk-ring transport
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def shm_win():
+    """Factory for single-process native windows with tiny chunks, with
+    teardown that unlinks every segment the test created."""
+    from bluefog_tpu.native.shm_native import NativeShmWindow
+
+    made = []
+
+    def make(shape, dtype, chunk=256, maxd=2, tag=""):
+        job = f"tnat{os.getpid()}{tag}{len(made)}"
+        w = NativeShmWindow(job, "w", rank=0, nranks=1, maxd=maxd,
+                            shape=shape, dtype=dtype, chunk=chunk)
+        made.append(w)
+        return w
+
+    yield make
+    for w in made:
+        w.close(unlink=True)
+
+
+@pytest.mark.parametrize(
+    "elems",
+    [0,      # empty payload: header-only slot, zero chunks' worth of bytes
+     16,     # 64 B: less than one 256 B chunk
+     128,    # 512 B: exactly 2 chunks
+     129],   # 2 chunks + one trailing element (short last chunk)
+)
+def test_chunk_ring_boundary_payloads(shm_win, elems):
+    w = shm_win((elems,), np.float32, chunk=256)
+    assert w.nchunks == max(1, -(-elems * 4 // 256))
+    x = np.arange(elems, dtype=np.float32)
+    w.write(0, 0, x, p=2.5)
+    out, p, version = w.read(0)
+    assert np.array_equal(out, x)
+    assert (p, version) == (2.5, 1)
+    w.expose(x, 1.5)
+    got, pe, _ = w.read_exposed(0)
+    assert np.array_equal(got, x) and pe == 1.5
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.int32])
+def test_chunk_ring_dtype_roundtrip(shm_win, dtype):
+    w = shm_win((300,), dtype)  # 300 elems: short last chunk for f32/f64
+    rng = np.random.default_rng(7)
+    x = (rng.standard_normal(300) * 100).astype(dtype)
+    w.write(0, 0, x)
+    out, _, _ = w.read(0)
+    assert np.array_equal(out, x)
+    if np.dtype(dtype) == np.int32:  # raw transport: bytes only
+        with pytest.raises(TypeError):
+            w.write(0, 0, x, accumulate=True)
+        with pytest.raises(TypeError):
+            w.write(0, 0, x, scale=0.5)
+
+
+def test_chunk_ring_drained_marker(shm_win):
+    w = shm_win((100,), np.float32)
+    x = np.full(100, 3.0, dtype=np.float32)
+    w.write(0, 0, x, p=1.0)
+    out, p, _ = w.read(0, collect=True)
+    assert np.array_equal(out, x) and p == 1.0
+    # drained slot reads as logical zeros without any zeroing pass
+    out2, p2, _ = w.read(0)
+    assert not out2.any() and p2 == 0.0
+    # accumulate into a drained slot degrades to a copy (stale mass is
+    # invisible), then stacks normally
+    w.write(0, 0, x, p=1.0, accumulate=True)
+    w.write(0, 0, x, p=1.0, accumulate=True)
+    out3, p3, _ = w.read(0)
+    assert np.allclose(out3, 2 * x) and p3 == 2.0
+
+
+def test_chunk_ring_scaled_write_and_combine(shm_win):
+    w = shm_win((257,), np.float64)
+    x = np.linspace(0.0, 1.0, 257)
+    w.write(0, 0, x, p=1.0, scale=0.25)
+    acc = np.ones(257)
+    p, version = w.combine(0, acc, weight=2.0, collect=True)
+    assert np.allclose(acc, 1.0 + 2.0 * 0.25 * x)
+    assert p == 1.0 and version == 1
+    # combine against the now-drained slot is a no-op with p == 0
+    acc2 = acc.copy()
+    p0, _ = w.combine(0, acc2, weight=2.0)
+    assert np.array_equal(acc2, acc) and p0 == 0.0
+
+
+def test_chunk_ring_put_dual_and_fused_update(shm_win):
+    w = shm_win((500,), np.float32)
+    x = np.arange(500, dtype=np.float32)
+    # one call, both legs: exposed tensor (unscaled) + mail slot (scaled)
+    w.put_dual(0, 0, x, p=0.5, scale=0.5, expose_p=1.0)
+    exp, pe, _ = w.read_exposed(0)
+    assert np.array_equal(exp, x) and pe == 1.0
+    mail, pm, _ = w.read(0)
+    assert np.allclose(mail, 0.5 * x) and pm == 0.5
+    # fused update, explicit out buffer
+    out = np.empty(500, dtype=np.float32)
+    p_acc = w.update_fused([0], [1.0], x, 0.5, 1.0, out, collect=True,
+                           expose=2)
+    assert np.allclose(out, 0.5 * x + 0.5 * x)
+    assert p_acc == 0.5 * 1.0 + 1.0 * 0.5
+    # fused update IN PLACE: destination is the exposed payload itself
+    v = w.exposed_view()
+    assert np.allclose(v, out)  # republished by the previous call
+    p_acc2 = w.update_fused([0], [1.0], v, 0.5, p_acc, None, expose=2)
+    assert np.allclose(v, 0.5 * out)  # drained slot contributes nothing
+    assert p_acc2 == 0.5 * p_acc
+    got, pg, _ = w.read_exposed(0)
+    assert np.allclose(got, v) and pg == p_acc2
+
+
+def test_chunk_ring_exposed_view_survives_close():
+    from bluefog_tpu.native.shm_native import NativeShmWindow
+
+    w = NativeShmWindow(f"tnatv{os.getpid()}", "w", rank=0, nranks=1,
+                        maxd=1, shape=(64,), dtype=np.float32, chunk=128)
+    x = np.linspace(1.0, 2.0, 64, dtype=np.float32)
+    w.expose(x, 1.0)
+    v = w.exposed_view()
+    assert np.array_equal(v, x)
+    w.close(unlink=True)  # unmaps the window's native mapping
+    # the view owns an independent mapping of the same pages
+    assert np.array_equal(v, x)
+
+
+def test_chunk_ring_probe_roundtrip(shm_win):
+    w = shm_win((1000,), np.float32, chunk=512)
+    rng = np.random.default_rng(11)
+    src = rng.standard_normal(1000).astype(np.float32)
+    dst = np.zeros(1000, dtype=np.float32)
+    w.probe(src, dst)
+    assert np.array_equal(dst, src)
+    # the probe drains its slot on the way out
+    out, p, _ = w.read(0)
+    assert not out.any() and p == 0.0
+
+
+def test_chunk_ring_mirror_torn_writer_retry():
+    from bluefog_tpu.native.shm_native import ChunkRingMirror
+
+    m = ChunkRingMirror(1024, chunk=256)
+    assert m.nchunks == 4
+    first = bytes(range(256)) * 4
+    m.write(first, p=1.0)
+    assert m.read() == (first, 1.0, 1)
+    second = bytes(reversed(range(256))) * 4
+    m.begin_torn_write(second, p=2.0, tear_at=2)
+    # whole-slot bracket refuses while wseq is odd
+    with pytest.raises(TimeoutError):
+        m.read(retries=8)
+    # committed chunks ahead of the tear are already consumable (the
+    # pipelined reader's whole point)...
+    assert m.read_chunk(0) == second[0:256]
+    assert m.read_chunk(1) == second[256:512]
+    # ...the torn chunk is not (its seqlock is parked odd)
+    with pytest.raises(TimeoutError):
+        m.read_chunk(2, retries=8)
+    m.complete_write()
+    assert m.read() == (second, 2.0, 2)
+
+
+def test_chunk_ring_mirror_boundary_chunk_math():
+    from bluefog_tpu.native.shm_native import ChunkRingMirror
+
+    empty = ChunkRingMirror(0, chunk=256)
+    empty.write(b"", p=3.0)
+    assert empty.read() == (b"", 3.0, 1)
+
+    short_tail = ChunkRingMirror(513, chunk=256)  # 2 chunks + 1 byte
+    assert short_tail.nchunks == 3
+    data = bytes(i % 251 for i in range(513))
+    short_tail.write(data)
+    assert short_tail.read()[0] == data
+    assert short_tail.read_chunk(2) == data[512:]
