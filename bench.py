@@ -451,6 +451,17 @@ def main():
             print(json.dumps(bw_proto), file=sys.stderr)
         except Exception as e:  # noqa: BLE001
             print(f"island protocol phase failed: {e!r}", file=sys.stderr)
+    rec = None
+    if time.perf_counter() - t_start < budget_s:
+        try:
+            # resilience headline (docs/RESILIENCE.md): SIGKILL one of 4
+            # gossiping island ranks, measure the median survivor's
+            # kill-to-first-healed-gossip-round latency
+            from recovery import measure_recovery
+            rec = measure_recovery(nprocs=4)
+            print(json.dumps(rec), file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"recovery phase failed: {e!r}", file=sys.stderr)
 
     headline = {
         "metric": "ResNet-50 images/sec/chip (neighbor_allreduce exp2)"
@@ -506,6 +517,12 @@ def main():
     if bw_proto is not None:
         headline["island_protocol_ceiling_gbs"] = bw_proto["value"]
         headline["island_protocol_vs_raw_memcpy"] = bw_proto["vs_raw_memcpy"]
+    if rec is not None:
+        headline["recovery_ms"] = rec["value"]
+        headline["recovery_metric"] = rec["metric"]
+        # the detector floor: recovery_ms minus this is drain + replan +
+        # one degraded gossip round
+        headline["recovery_failure_timeout_ms"] = rec["failure_timeout_ms"]
     print(json.dumps(headline))
 
 

@@ -1,0 +1,111 @@
+"""Recovery benchmark: SIGKILL-to-first-healed-gossip-round latency.
+
+The resilience headline (docs/RESILIENCE.md): with ``nprocs`` island
+ranks gossiping over exp2 through the shm mailbox, the parent SIGKILLs
+one rank and each survivor independently detects the death (heartbeat
+stamp ages past ``BFTPU_FAILURE_TIMEOUT_S``), heals the topology
+(force-drain + Metropolis–Hastings re-weighting over the survivors),
+and completes one full degraded gossip round.  ``value`` is the median
+survivor's kill-to-first-healed-round wall time in ms — dominated by
+the failure timeout by construction, so the interesting part is the
+margin above it (drain + replan + one round).
+
+``time.monotonic`` is CLOCK_MONOTONIC, system-wide on Linux, so the
+parent's kill stamp and the survivors' healed stamps share a clock.
+"""
+
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_FAILURE_TIMEOUT_S = 0.5
+
+
+def _worker(rank, size, job, q):
+    from bluefog_tpu import islands, topology_util
+
+    islands.init(rank, size, job)
+    islands.set_topology(topology_util.ExponentialTwoGraph(size))
+    islands.win_create(np.full(4, float(rank), np.float64), "rec")
+    islands.barrier()
+    q.put(("up", rank, os.getpid(), time.monotonic()))
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline and not islands.dead_ranks():
+        islands.win_put(islands.win_sync("rec"), "rec")
+        islands.win_update("rec")
+        time.sleep(0.002)
+    healed = islands.heal()
+    if healed is not None:
+        # first full gossip round on the healed topology
+        islands.win_put(islands.win_sync("rec"), "rec")
+        islands.win_update("rec")
+        q.put(("healed", rank, tuple(healed.dead), time.monotonic()))
+    islands.shutdown(unlink=False)
+
+
+def measure_recovery(nprocs: int = 4, victim: int = 1,
+                     failure_timeout_s: float = _FAILURE_TIMEOUT_S) -> dict:
+    """Kill one of ``nprocs`` gossiping island ranks; return the metric
+    dict with ``value`` = median survivor kill-to-first-healed-round ms
+    (bench.py rides this in the headline's ``recovery_ms`` key)."""
+    import multiprocessing as mp
+
+    from bluefog_tpu.native import shm_native
+
+    job = f"recov{os.getpid()}"
+    saved = os.environ.get("BFTPU_FAILURE_TIMEOUT_S")
+    os.environ["BFTPU_FAILURE_TIMEOUT_S"] = str(failure_timeout_s)
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_worker, args=(r, nprocs, job, q))
+             for r in range(nprocs)]
+    try:
+        for p in procs:
+            p.start()
+        pids = {}
+        for _ in range(nprocs):
+            tag, r, pid, _t = q.get(timeout=300)
+            assert tag == "up"
+            pids[r] = pid
+        time.sleep(0.3)  # steady-state gossip before the fault
+        t_kill = time.monotonic()
+        os.kill(pids[victim], signal.SIGKILL)
+        lat_ms = []
+        for _ in range(nprocs - 1):
+            tag, r, dead, t_healed = q.get(
+                timeout=60 + 10 * failure_timeout_s)
+            assert tag == "healed" and victim in dead, (tag, r, dead)
+            lat_ms.append((t_healed - t_kill) * 1000.0)
+    finally:
+        for p in procs:
+            p.join(timeout=15)
+            if p.is_alive():
+                p.terminate()
+        shm_native.unlink_all(job, ["rec"])
+        if saved is None:
+            os.environ.pop("BFTPU_FAILURE_TIMEOUT_S", None)
+        else:
+            os.environ["BFTPU_FAILURE_TIMEOUT_S"] = saved
+    lat_ms.sort()
+    median = lat_ms[len(lat_ms) // 2]
+    return {
+        "metric": f"rank-kill to first healed gossip round "
+                  f"(exp2, {nprocs} procs, shm mailbox)",
+        "value": round(median, 1),
+        "unit": "ms",
+        # the detector floor: value - this = drain + replan + one round
+        "failure_timeout_ms": round(failure_timeout_s * 1000.0, 1),
+        "survivor_range_ms": [round(lat_ms[0], 1), round(lat_ms[-1], 1)],
+        "survivors": nprocs - 1,
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(measure_recovery()))

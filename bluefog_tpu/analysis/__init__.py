@@ -13,6 +13,11 @@ consumers (CLI, pytest, CI):
 - **protocol** (:mod:`.seqlock_model`, :mod:`.epoch_rules`) — exhaustive
   interleaving check of the shm-mailbox seqlock/collect/barrier at small
   bounds, plus the window-op epoch-ordering lint;
+- **resilience** (:mod:`.resilience_rules`, plus the dead-writer-drain
+  model in :mod:`.seqlock_model`) — healed survivor topologies stay
+  doubly stochastic and mixing with the dead fully excised, degraded
+  combine rows conserve mass, and the force-drain of a dead writer's
+  slot loses no committed deposit at any death point;
 - the **fixture corpus** (:mod:`.fixtures`) — seeded bugs proving every
   rule fires.
 
@@ -38,6 +43,7 @@ from bluefog_tpu.analysis import (  # noqa: F401
     hlo_corpus,
     hlo_rules,
     plan_rules,
+    resilience_rules,
     seqlock_model,
 )
 

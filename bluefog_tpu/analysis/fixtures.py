@@ -23,7 +23,15 @@ from typing import Callable, Dict, List
 from bluefog_tpu import topology_util as tu
 from bluefog_tpu.core.plan import compile_plan, plan_from_neighbor_lists
 
-from bluefog_tpu.analysis import epoch_rules, hlo_rules, plan_rules, seqlock_model
+from bluefog_tpu.resilience.healing import heal_topology
+
+from bluefog_tpu.analysis import (
+    epoch_rules,
+    hlo_rules,
+    plan_rules,
+    resilience_rules,
+    seqlock_model,
+)
 from bluefog_tpu.analysis.engine import Finding
 
 __all__ = ["FIXTURES", "run_fixture"]
@@ -135,6 +143,35 @@ def _hlo_replicated_large_buffer() -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# resilience fixtures: botched healings + broken drain protocols
+# ---------------------------------------------------------------------------
+
+
+def _healed_dead_not_excised() -> List[Finding]:
+    """A healing that declares rank 2 dead but forgot to excise it: the
+    survivor set (and hence the plan) still schedules the corpse."""
+    healed = heal_topology(tu.ExponentialTwoGraph(8), dead=[3])
+    lied = dataclasses.replace(healed, dead=(2,))
+    return resilience_rules.check_dead_excised(lied, "exp2@8[corpse-kept]")
+
+
+def _healed_not_doubly_stochastic() -> List[Finding]:
+    """A healed plan whose Metropolis–Hastings re-weighting was skipped
+    for one edge (weight doubled): the survivor W stops being
+    stochastic, so degraded gossip drifts off the survivor average."""
+    healed = heal_topology(tu.ExponentialTwoGraph(8), dead=[3])
+    cls = healed.plan.classes[0]
+    rw = list(cls.recv_weights)
+    idx = next(i for i, w in enumerate(rw) if w != 0.0)
+    rw[idx] *= 2.0
+    bad = dataclasses.replace(cls, recv_weights=tuple(rw))
+    mutated = dataclasses.replace(healed.plan,
+                                  classes=(bad,) + healed.plan.classes[1:])
+    return plan_rules.check_mixing_stochastic(
+        mutated, "exp2@8-dead[3][skipped-mh]", expect_column=True)
+
+
+# ---------------------------------------------------------------------------
 # protocol fixtures: broken seqlock/collect/barrier variants + bad traces
 # ---------------------------------------------------------------------------
 
@@ -174,6 +211,15 @@ FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
                                        frontier_reader=True)),
     "chunk-drained-split-collect": lambda: _model_fixture(
         seqlock_model.drained_collect_model(2, atomic_collect=False)),
+    # resilience family: botched healings + broken dead-writer drains
+    "healed-dead-rank-not-excised": _healed_dead_not_excised,
+    "healed-not-doubly-stochastic": _healed_not_doubly_stochastic,
+    "dead-writer-lost-mass-drain": lambda: _model_fixture(
+        seqlock_model.dead_writer_drain_model(deposits=2,
+                                              account_wiped=False)),
+    "dead-writer-early-commit": lambda: _model_fixture(
+        seqlock_model.dead_writer_drain_model(deposits=2,
+                                              commits_after_payload=False)),
     # epoch family: ill-ordered window traces
     "epoch-use-after-free": lambda: epoch_rules.check_trace(
         [("win_create", "w"), ("win_put", "w"), ("win_free", "w"),

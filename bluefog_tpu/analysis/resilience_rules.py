@@ -1,0 +1,185 @@
+"""Rule family 5: static verification of healed (post-failure) topologies.
+
+When ranks die, :func:`bluefog_tpu.resilience.healing.heal_topology`
+rebuilds the gossip over the survivors.  A healed topology is exactly as
+load-bearing as a fresh one — every invariant the plan family checks on
+the named corpus must hold on the healed artifacts too, or the surviving
+job silently diverges:
+
+- the dead ranks are fully EXCISED: no survivor, no node, no scheduled
+  edge references them (a dead rank left in the plan deposits into a
+  drained slot forever — its neighbors average in zeros);
+- the survivor mixing matrix is doubly stochastic (row AND column sums
+  1): Metropolis–Hastings over the symmetrized induced subgraph — the
+  condition under which degraded gossip still converges to the exact
+  survivor average;
+- the spectral gap stays strictly positive: the ring-reconnect step must
+  have restored connectivity whenever the excision cut the graph;
+- the recompiled plan covers the healed edge set exactly, with valid
+  permutation classes and consistent slot bookkeeping — the plan rules,
+  re-run on the healed subject.
+
+The corpus is every named topology x sizes 4..16 x a spread of dead-rank
+sets (first rank, last rank, an interior pair, and — where it exists —
+the star's center, the excision that forces a reconnect).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bluefog_tpu.resilience.healing import HealedTopology, heal_topology
+
+from bluefog_tpu.analysis import plan_rules
+from bluefog_tpu.analysis.engine import Finding, Report, registry
+
+__all__ = [
+    "HEALED_SIZES",
+    "dead_sets",
+    "check_dead_excised",
+    "check_healed",
+]
+
+HEALED_SIZES: Tuple[int, ...] = tuple(range(4, 17))
+
+
+def dead_sets(size: int) -> List[Tuple[int, ...]]:
+    """The dead-rank sets exercised per (topology, size): single deaths
+    at both id extremes, an interior pair, and near-majority loss."""
+    out = [(0,), (size - 1,)]
+    if size > 3:
+        out.append((1, 2))
+    if size > 5:
+        out.append(tuple(range(1, size - 2)))  # 3 survivors
+    return out
+
+
+def check_dead_excised(healed: HealedTopology,
+                       label: str = "healed") -> List[Finding]:
+    """Every trace of the dead ranks must be gone from the healed
+    artifacts: survivors, topology nodes, and plan edges (mapped back to
+    global ids via ``to_global``)."""
+    out: List[Finding] = []
+    dead = set(healed.dead)
+    leaked = dead & set(healed.survivors)
+    if leaked:
+        out.append(Finding(
+            "resilience.dead-excised", label,
+            f"dead rank(s) {sorted(leaked)} still listed as survivors — "
+            "the healed gossip would keep scheduling a corpse"))
+    if healed.plan.size != len(healed.survivors):
+        out.append(Finding(
+            "resilience.dead-excised", label,
+            f"healed plan has size {healed.plan.size} but there are "
+            f"{len(healed.survivors)} survivors"))
+    to_global = healed.to_global
+    bad_edges = []
+    for cls in healed.plan.classes:
+        for s, d in cls.perm:
+            for local in (s, d):
+                if 0 <= local < len(to_global) \
+                        and to_global[local] in dead:
+                    bad_edges.append((s, d))
+    if bad_edges:
+        out.append(Finding(
+            "resilience.dead-excised", label,
+            f"scheduled edge(s) {sorted(set(bad_edges))[:6]} map to dead "
+            "global rank(s) — survivors would win_put into force-drained "
+            "slots forever"))
+    mapped = {to_global[i] for i in range(len(to_global))}
+    if mapped & dead:
+        out.append(Finding(
+            "resilience.dead-excised", label,
+            f"to_global maps local ids onto dead rank(s) "
+            f"{sorted(mapped & dead)}"))
+    return out
+
+
+def check_healed(healed: HealedTopology, label: str = "healed",
+                 report: Optional[Report] = None) -> Report:
+    """All resilience + plan rules on one healed topology; the healed W
+    must be doubly stochastic and mixing, the plan valid over the healed
+    edge set, the dead ranks fully excised."""
+    report = report if report is not None else Report()
+    report.subjects_checked += 1
+    report.extend(check_dead_excised(healed, label))
+    plan, topo = healed.plan, healed.topology
+    report.extend(plan_rules.check_classes_are_permutations(plan, label))
+    report.extend(plan_rules.check_edge_cover(plan, topo, label))
+    report.extend(plan_rules.check_slot_consistency(plan, label))
+    # expect_column=True: the healing contract is DOUBLY stochastic
+    report.extend(plan_rules.check_mixing_stochastic(
+        plan, label, expect_column=True))
+    findings, gap = plan_rules.check_spectral_gap(plan, label)
+    report.extend(findings)
+    report.metric(f"resilience.spectral_gap/{label}", round(gap, 6))
+    return report
+
+
+def iter_healed_corpus(sizes: Sequence[int] = HEALED_SIZES
+                       ) -> Iterable[Tuple[str, HealedTopology]]:
+    for name, ctor in plan_rules.CORPUS_TOPOLOGIES.items():
+        for n in sizes:
+            topo = ctor(n)
+            for dead in dead_sets(n):
+                label = f"{name}@{n}-dead{list(dead)}"
+                yield label, heal_topology(topo, dead)
+
+
+@registry.rule("resilience.healed-corpus", "resilience",
+               "every named topology x sizes 4..16 x dead-rank sets: the "
+               "healed survivor topology is doubly stochastic, mixing, "
+               "fully excises the dead, and recompiles to a valid plan")
+def _run_healed_corpus(report: Report) -> None:
+    worst = {}
+    for label, healed in iter_healed_corpus():
+        report.subjects_checked += 1
+        report.extend(check_dead_excised(healed, label))
+        plan, topo = healed.plan, healed.topology
+        report.extend(plan_rules.check_classes_are_permutations(plan, label))
+        report.extend(plan_rules.check_edge_cover(plan, topo, label))
+        report.extend(plan_rules.check_slot_consistency(plan, label))
+        report.extend(plan_rules.check_mixing_stochastic(
+            plan, label, expect_column=True))
+        findings, gap = plan_rules.check_spectral_gap(plan, label)
+        report.extend(findings)
+        fam = label.split("@")[0]
+        worst[fam] = min(worst.get(fam, 1.0), gap)
+    for fam, gap in sorted(worst.items()):
+        report.metric(f"resilience.min_healed_spectral_gap/{fam}",
+                      round(gap, 6))
+
+
+@registry.rule("resilience.degraded-weights", "resilience",
+               "self-weight renormalization of combine rows: dropping "
+               "dead neighbors conserves the row total for uniform, "
+               "convex, and push-sum (all-ones) rows")
+def _run_degraded_weights(report: Report) -> None:
+    from bluefog_tpu.resilience.degraded import renormalize_weights
+    rng = np.random.default_rng(7)
+    for n in (2, 4, 8):
+        for trial in range(8):
+            w = rng.dirichlet(np.ones(n + 1))
+            sw, nw = float(w[0]), {i: float(w[i + 1]) for i in range(n)}
+            dead = set(int(i) for i in
+                       rng.choice(n, size=rng.integers(0, n + 1),
+                                  replace=False))
+            sw2, nw2 = renormalize_weights(sw, nw, dead)
+            label = f"dirichlet@{n} trial {trial} dead={sorted(dead)}"
+            report.subjects_checked += 1
+            total = sw2 + sum(nw2.values())
+            if abs(total - 1.0) > 1e-9:
+                report.add(Finding(
+                    "resilience.degraded-weights", label,
+                    f"renormalized row sums to {total!r}, expected 1"))
+            if set(nw2) & dead:
+                report.add(Finding(
+                    "resilience.degraded-weights", label,
+                    f"dead neighbor(s) {sorted(set(nw2) & dead)} survive "
+                    "renormalization"))
+            if any(v < -1e-12 for v in nw2.values()) or sw2 < -1e-12:
+                report.add(Finding(
+                    "resilience.degraded-weights", label,
+                    "negative weight after renormalization"))

@@ -43,6 +43,8 @@ from bluefog_tpu.native.shm_native import (
     CHUNK_READER_STEPS,
     CHUNK_WRITER_STEPS,
     COLLECT_IS_ATOMIC,
+    DEAD_WRITER_DRAIN_STEPS,
+    DEPOSIT_COMMITS_AFTER_PAYLOAD,
     DRAINED_COLLECT_IS_ATOMIC,
     SEQLOCK_READER_STEPS,
     SEQLOCK_WRITER_STEPS,
@@ -58,6 +60,7 @@ __all__ = [
     "barrier_model",
     "chunk_ring_model",
     "drained_collect_model",
+    "dead_writer_drain_model",
     "check_model",
 ]
 
@@ -646,6 +649,152 @@ def drained_collect_model(deposits: int = 2,
 
 
 # ---------------------------------------------------------------------------
+# model 2d: dead-writer force-drain (resilience — no deposited mass lost)
+# ---------------------------------------------------------------------------
+
+
+def dead_writer_drain_model(deposits: int = 2, collects: int = 1,
+                            commits_after_payload: bool =
+                            DEPOSIT_COMMITS_AFTER_PAYLOAD,
+                            account_wiped: bool = True) -> Model:
+    """A writer that may DIE at any protocol step (SIGKILL: no cleanup,
+    lock possibly held mid-deposit) against the slot owner, who collects
+    normally until the failure detector fires and then applies the
+    force-drain rule (``bf_shm_win_force_drain``: mark the slot drained,
+    then break the dead writer's lock — DEAD_WRITER_DRAIN_STEPS).
+
+    Proves, over every death point and interleaving:
+
+    - **no unbacked mass**: every unit that ever becomes visible
+      (``version``/``m`` committed) has its payload fully written first —
+      the reason ``slot_deposit`` commits AFTER the chunk writes
+      (DEPOSIT_COMMITS_AFTER_PAYLOAD).  Seeded bug
+      ``commits_after_payload=False``: a writer dying between commit and
+      payload makes the owner collect a unit that was never deposited.
+    - **no lost deposit**: every committed unit is collected, wiped by
+      the accounted force-drain, or still logically in the slot —
+      ``collected + wiped + logical == committed`` in every final state.
+      Seeded bug ``account_wiped=False``: the drain marks the slot
+      drained without accounting the in-transit mass to the dead rank's
+      excised ledger, silently destroying deposits that had committed.
+    - **no stranded survivor**: the owner never deadlocks on the dead
+      writer's lock (the drain breaks it) — the built-in deadlock check.
+
+    A writer that dies BEFORE committing leaves ``paid`` > ``committed``:
+    that mass died with the writer and is charged to the dead rank by the
+    healing rules, not to this slot — the model deliberately does not
+    count it.
+    """
+    shared = {"lock": 0, "m": 0, "version": 0, "drained": 0,
+              "dead": 0, "paid": 0, "committed": 0, "collected": 0,
+              "wiped": 0}
+
+    def logical(sh) -> int:
+        return 0 if sh["drained"] == sh["version"] else sh["m"]
+
+    def dying(step):
+        """Wrap a writer step: at every pc the writer may also die in
+        place — pc jumps past the program end, shared state (including a
+        held lock) frozen as-is."""
+        def wrapped(sh, rg):
+            succ = list(step(sh, rg))
+            succ.extend(_s(sh, rg, 10_000, dead=1))
+            return succ
+        return wrapped
+
+    def w_acquire(sh, rg, nxt):
+        if sh["lock"]:
+            return []
+        return _s(sh, rg, nxt, lock=1)
+
+    def w_payload(sh, rg, nxt):
+        return _s(sh, rg, nxt, paid=sh["paid"] + 1)
+
+    def w_commit(sh, rg, nxt):
+        return _s(sh, rg, nxt, m=logical(sh) + 1,
+                  version=sh["version"] + 1,
+                  committed=sh["committed"] + 1)
+
+    def w_release(sh, rg, nxt):
+        return _s(sh, rg, nxt, lock=0)
+
+    order = ([w_acquire, w_payload, w_commit, w_release]
+             if commits_after_payload
+             # seeded bug: visibility before the payload lands
+             else [w_acquire, w_commit, w_payload, w_release])
+    writer: List[Callable] = []
+    for _dep in range(deposits):
+        base = len(writer)
+        for i, s in enumerate(order):
+            def pinned(sh, rg, s=s, nxt=base + i + 1):
+                return s(sh, rg, nxt)
+            writer.append(dying(pinned))
+
+    owner: List[Callable] = []
+    for _c in range(collects):
+        nxt = len(owner) + 1
+
+        def c_try_collect(sh, rg, nxt=nxt):
+            # atomic read+mark under the lock (the v2 locked collect,
+            # coarsened: the lock serializes it against the writer), or
+            # skip this round — both orders are explored
+            succ = _s(sh, rg, nxt)  # skip
+            if not sh["lock"]:
+                got = logical(sh)
+                succ += _s(sh, rg, nxt,
+                           collected=sh["collected"] + got,
+                           drained=sh["version"])
+            return succ
+        owner.append(c_try_collect)
+
+    base = len(owner)
+
+    def o_detect(sh, rg, base=base):
+        # the failure detector: fires only once the writer is truly dead;
+        # the no-failure path skips the drain entirely
+        succ = _s(sh, rg, base + 3)  # no drain (detector never fired)
+        if sh["dead"]:
+            succ += _s(sh, rg, base + 1)
+        return succ
+
+    def o_wipe(sh, rg, base=base):
+        # mark_drained: in-transit mass is charged to the dead rank's
+        # excised ledger (account_wiped) and the slot reads as zero
+        got = logical(sh)
+        return _s(sh, rg, base + 2, drained=sh["version"],
+                  wiped=sh["wiped"] + (got if account_wiped else 0))
+
+    def o_break_lock(sh, rg, base=base):
+        # clear_lock comes LAST in DEAD_WRITER_DRAIN_STEPS: nobody can
+        # slip into a half-drained slot
+        return _s(sh, rg, base + 3, lock=0)
+
+    owner.extend([o_detect, o_wipe, o_break_lock])
+
+    # spec sync: the drain rule this model vouches for must mark the
+    # drained slot before clearing the dead writer's lock
+    assert DEAD_WRITER_DRAIN_STEPS.index("mark_drained") \
+        < DEAD_WRITER_DRAIN_STEPS.index("clear_lock"), \
+        "model drifted from shm_native.DEAD_WRITER_DRAIN_STEPS"
+
+    def conserved(sh) -> Optional[str]:
+        if sh["committed"] > sh["paid"]:
+            return (f"unbacked mass: {sh['committed']} unit(s) committed "
+                    f"but only {sh['paid']} payload(s) fully written — a "
+                    "torn deposit became visible (commit must follow the "
+                    "payload)")
+        if sh["collected"] + sh["wiped"] + logical(sh) != sh["committed"]:
+            return (f"lost deposit: committed={sh['committed']} but "
+                    f"collected={sh['collected']} + wiped={sh['wiped']} + "
+                    f"logical-remaining={logical(sh)} — the drain rule "
+                    "destroyed committed mass without accounting it")
+        return None
+
+    return Model(name="dead-writer-drain", shared=shared,
+                 programs=[writer, owner], final_check=conserved)
+
+
+# ---------------------------------------------------------------------------
 # model 3: sense-reversing barrier (lost wakeup)
 # ---------------------------------------------------------------------------
 
@@ -766,3 +915,14 @@ def _run_drained_collect(report: Report) -> None:
     for deposits in (1, 2, 3):
         check_model(drained_collect_model(deposits=deposits), report,
                     rule="protocol.chunk-drained-mass-conservation")
+
+
+@registry.rule("resilience.dead-writer-drain", "resilience",
+               "a writer dying at ANY protocol step: the force-drain "
+               "rule neither loses committed mass nor surfaces a torn "
+               "deposit nor strands the surviving slot owner")
+def _run_dead_writer_drain(report: Report) -> None:
+    for deposits, collects in ((1, 1), (2, 1), (2, 2), (3, 1)):
+        check_model(
+            dead_writer_drain_model(deposits=deposits, collects=collects),
+            report, rule="resilience.dead-writer-drain")

@@ -97,6 +97,18 @@ class CommPlan:
                 W[d, s] += cls.recv_weights[d]
         return W
 
+    def stochasticity_error(self) -> Tuple[float, float]:
+        """(max |row sum - 1|, max |col sum - 1|) of the mixing matrix.
+
+        Row error ~0 means weighted combines are convex (any valid plan);
+        col error ~0 additionally means gossip preserves the global
+        average — the contract healed survivor plans must meet
+        (resilience/healing.py)."""
+        W = self.mixing_matrix()
+        row = float(np.abs(W.sum(axis=1) - 1.0).max()) if self.size else 0.0
+        col = float(np.abs(W.sum(axis=0) - 1.0).max()) if self.size else 0.0
+        return row, col
+
 
 def _edge_classes_and_slots(size, edges):
     """Per-edge (class index, allgather slot).  Uses the native C++ compiler

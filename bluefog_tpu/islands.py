@@ -38,6 +38,7 @@ import contextlib
 import itertools
 import os
 import sys
+import time
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import networkx as nx
@@ -45,6 +46,9 @@ import numpy as np
 
 from bluefog_tpu import topology_util
 from bluefog_tpu.native import shm_native
+from bluefog_tpu.resilience import degraded as _degraded
+from bluefog_tpu.resilience import healing as _healing
+from bluefog_tpu.resilience.detector import FailureDetector
 from bluefog_tpu.timeline import timeline_context
 
 __all__ = [
@@ -76,6 +80,9 @@ __all__ = [
     "get_win_version",
     "turn_on_win_ops_with_associated_p",
     "turn_off_win_ops_with_associated_p",
+    "dead_ranks",
+    "heal",
+    "resilience_detector",
     "spawn",
 ]
 
@@ -129,6 +136,13 @@ class _IslandContext:
         self.win_fusion: Dict[str, object] = {}  # name -> pytree pack meta
         self.associated_p = False
         self.shm_job = shm_native.make_job(job, rank_, size_)
+        # resilience state: the detector heartbeats in the background on
+        # transports exposing liveness words (shm native/fallback, tcp
+        # leases); ``dead`` is the excised-rank set the degraded win ops
+        # consult, populated by heal()
+        self.detector = FailureDetector(self.shm_job, rank_, size_).start()
+        self.dead: set = set()
+        self.healed: Optional[_healing.HealedTopology] = None
 
 
 def _trivial_graph() -> nx.DiGraph:
@@ -184,6 +198,7 @@ def shutdown(unlink: bool = False) -> None:
     if _context is None:
         return
     ctx = _context
+    ctx.detector.stop()
     for w in ctx.windows.values():
         w.shm.close(unlink=False)
     names = list(ctx.created_names)
@@ -214,10 +229,16 @@ def size() -> int:
     return _ctx().size
 
 
-def barrier() -> None:
+def barrier(timeout: Optional[float] = None) -> None:
     """Explicit global barrier (init/teardown/tests; the async hot loop
-    never calls this — that is the point of islands)."""
-    _ctx().shm_job.barrier()
+    never calls this — that is the point of islands).  With ``timeout``
+    (seconds) the wait is bounded: TimeoutError if the barrier does not
+    complete — the arrival is retracted, so a later barrier is unharmed.
+    Raises TypeError on transports without timed-barrier support."""
+    if timeout is None:
+        _ctx().shm_job.barrier()
+    else:
+        _ctx().shm_job.barrier(timeout=timeout)
 
 
 def set_topology(topo: nx.DiGraph) -> bool:
@@ -243,6 +264,62 @@ def in_neighbor_ranks() -> List[int]:
 def out_neighbor_ranks() -> List[int]:
     ctx = _ctx()
     return sorted(ctx.topology.successors(ctx.rank))
+
+
+# ---------------------------------------------------------------------------
+# resilience: failure detection + topology healing (docs/RESILIENCE.md)
+# ---------------------------------------------------------------------------
+
+
+def resilience_detector() -> FailureDetector:
+    """This rank's heartbeat failure detector (started at init on
+    transports with liveness support)."""
+    return _ctx().detector
+
+
+def dead_ranks() -> set:
+    """Ranks the failure detector currently considers dead (monotone:
+    once declared, a rank stays dead for this job)."""
+    return _ctx().detector.dead_ranks()
+
+
+def heal(dead=None):
+    """Excise ``dead`` ranks (default: the detector's verdict) from the
+    gossip: force-drain their mailbox slots (a writer that died
+    mid-deposit committed zero mass — see DEPOSIT_COMMITS_AFTER_PAYLOAD),
+    break any job mutex they held, and record them so every subsequent
+    win op skips them and renormalizes its combine weights
+    (mass-conserving degraded steps).  Returns the
+    :class:`~bluefog_tpu.resilience.healing.HealedTopology` — survivor
+    topology, doubly-stochastic W, and recompiled plan — or None when
+    nothing is dead.
+
+    Idempotent and rank-local: every survivor calls it on its own
+    schedule; no collective required (there is no one left to
+    coordinate with — that is the failure mode being handled).
+    """
+    ctx = _ctx()
+    dead = set(ctx.detector.dead_ranks() if dead is None else dead)
+    for r in dead:
+        ctx.detector.declare_dead(r)
+    if not dead:
+        return ctx.healed
+    new = dead - ctx.dead
+    ctx.dead |= dead
+    for r in sorted(new):
+        # a rank that died holding a mutex must not wedge win_mutex
+        breaker = getattr(ctx.shm_job, "mutex_break", None)
+        if breaker is not None:
+            breaker(r)
+    for win in ctx.windows.values():
+        drain = getattr(win.shm, "force_drain", None)
+        if drain is None:
+            continue
+        for s in win.in_neighbors:
+            if s in new:
+                drain(win.slot_of[ctx.rank][s], src=s)
+    ctx.healed = _healing.heal_topology(ctx.topology, sorted(ctx.dead))
+    return ctx.healed
 
 
 # ---------------------------------------------------------------------------
@@ -423,6 +500,10 @@ def win_put(tensor, name: str, dst_weights: WeightDict = None) -> bool:
         # memory, and the shm exposure below is already a stable snapshot
         win.self_tensor = t
         targets = _check_dst(win, dst_weights)
+        if ctx.dead:
+            # degraded step: a rank that died inside a fused combine holds
+            # its own slot locks forever — depositing to it would spin
+            targets = [d for d in targets if d not in ctx.dead]
         scaled = _scaled_transport(win)
         dual = getattr(win.shm, "put_dual", None) if scaled else None
         exposed = False
@@ -478,6 +559,8 @@ def win_accumulate(tensor, name: str, dst_weights: WeightDict = None) -> bool:
         win = _win(name)
         t = _to_host(_island_pack(name, tensor)).astype(win.shm.dtype, copy=False)
         targets = _check_dst(win, dst_weights)
+        if ctx.dead:
+            targets = [d for d in targets if d not in ctx.dead]
         scaled = _scaled_transport(win)
         for d in targets:
             wgt = 1.0 if dst_weights is None else float(dst_weights[d])
@@ -508,6 +591,8 @@ def win_get(name: str, src_weights: WeightDict = None) -> bool:
                     f"in-neighbors of rank {ctx.rank} are {win.in_neighbors}"
                 )
         sources = win.in_neighbors if src_weights is None else src_weights
+        if ctx.dead:
+            sources = [s for s in sources if s not in ctx.dead]
         scaled = _scaled_transport(win)
         for s in sources:
             wgt = 1.0 if src_weights is None else float(src_weights[s])
@@ -536,9 +621,20 @@ def _resolve_update_weights(win: _IslandWindow, self_weight, neighbor_weights):
             )
         nw = {s: float(neighbor_weights.get(s, 0.0)) for s in nbrs}
         sw = (1.0 - sum(nw.values())) if self_weight is None else float(self_weight)
+        dead = _ctx().dead
+        if dead and not dead.isdisjoint(nw):
+            # degraded combine, self-weight renormalization: drop dead
+            # neighbors and let self absorb their weight — the row total
+            # is unchanged, so a convex row stays convex and push-sum
+            # collect rows (all-ones) keep their unit slot weights
+            dropped = sum(w for s, w in nw.items() if s in dead)
+            nw = {s: w for s, w in nw.items() if s not in dead}
+            sw += dropped
     else:
-        u = 1.0 / (len(nbrs) + 1)
-        nw = {s: u for s in nbrs}
+        dead = _ctx().dead
+        live = [s for s in nbrs if s not in dead] if dead else nbrs
+        u = 1.0 / (len(live) + 1)
+        nw = {s: u for s in live}
         sw = u if self_weight is None else float(self_weight)
     return sw, nw
 
@@ -558,6 +654,9 @@ def win_update(
         ctx = _ctx()
         win = _win(name)
         sw, nw = _resolve_update_weights(win, self_weight, neighbor_weights)
+        # after healing, dead in-neighbors are absent from nw: their slots
+        # were force-drained and must not be combined (or even locked)
+        nbrs = [s for s in win.in_neighbors if s in nw]
         wdt = (win.shm.dtype if np.issubdtype(win.shm.dtype, np.inexact)
                else np.float64)
         fused = (getattr(win.shm, "update_fused", None)
@@ -569,8 +668,8 @@ def win_update(
             # cache-resident across sub-passes, so the round does ~one
             # traversal per payload instead of four.
             self_data = np.ascontiguousarray(win.self_tensor, dtype=wdt)
-            slots = [win.slot_of[ctx.rank][s] for s in win.in_neighbors]
-            wts = [nw[s] for s in win.in_neighbors]
+            slots = [win.slot_of[ctx.rank][s] for s in nbrs]
+            wts = [nw[s] for s in nbrs]
             view_fn = getattr(win.shm, "exposed_view", None)
             if view_fn is not None:
                 # in-place form: the combine's destination IS the exposed
@@ -612,7 +711,7 @@ def win_update(
             # native pass per neighbor under the slot lock — the slot
             # payload is never materialized on the Python side, and
             # collect (reset) happens in the same critical section.
-            for s in win.in_neighbors:
+            for s in nbrs:
                 p, _ = combine(win.slot_of[ctx.rank][s], acc, nw[s],
                                collect=reset, src=s)
                 p_acc = p_acc + nw[s] * p
@@ -628,7 +727,7 @@ def win_update(
                     or win._scratch.dtype != acc.dtype):
                 win._scratch = np.empty_like(acc)
             scratch = win._scratch
-            for s in win.in_neighbors:
+            for s in nbrs:
                 a, p, _ = win.shm.read(
                     win.slot_of[ctx.rank][s], collect=reset, src=s
                 )
@@ -652,7 +751,7 @@ def win_update_then_collect(name: str, require_mutex: bool = False):
     ``bf.win_update_then_collect`` [U]).  ``require_mutex`` is honored with
     the REAL shared-memory mutex (unlike the bulk-synchronous shim)."""
     win = _win(name)
-    ones = {s: 1.0 for s in win.in_neighbors}
+    ones = {s: 1.0 for s in win.in_neighbors if s not in _ctx().dead}
     cm = win_mutex(name, for_self=True) if require_mutex else contextlib.nullcontext()
     with cm:
         return win_update(name, self_weight=1.0, neighbor_weights=ones,
@@ -675,18 +774,39 @@ def win_mutex(name: str, for_self: bool = False,
     del name
     ctx = _ctx()
     targets = set(ranks) if ranks is not None else set(out_neighbor_ranks())
+    targets -= ctx.dead  # a dead rank's window needs no exclusion
     if for_self:
         targets.add(ctx.rank)
     ordered = sorted(targets)
     acquired = []
     try:
         for r in ordered:
-            ctx.shm_job.mutex_acquire(r)
+            _mutex_acquire_deadline(ctx, r)
             acquired.append(r)
         yield
     finally:
         for r in reversed(acquired):
             ctx.shm_job.mutex_release(r)
+
+
+def _mutex_acquire_deadline(ctx: "_IslandContext", r: int) -> None:
+    """Acquire rank ``r``'s job mutex under the op deadline.  A holder
+    that died mid-critical-section wedges a plain acquire forever; the
+    timed path re-consults the failure detector between attempts and
+    heals (which breaks dead holders' mutexes) so the retry succeeds.
+    Transports without timed acquire keep the unbounded wait."""
+
+    def on_timeout():
+        if ctx.detector.dead_ranks() - ctx.dead:
+            heal()
+
+    try:
+        _degraded.with_deadline(
+            lambda budget: ctx.shm_job.mutex_acquire(r, timeout=budget),
+            f"win_mutex acquire of rank {r}",
+            on_timeout=on_timeout)
+    except TypeError:
+        ctx.shm_job.mutex_acquire(r)
 
 
 def win_associated_p(name: str) -> float:
@@ -740,12 +860,16 @@ def push_sum_round(name: str, dst_weights: WeightDict = None):
     win = _win(name)
     cur = win.self_tensor
     p = win.p_self
+    live_out = [d for d in win.out_neighbors if d not in ctx.dead]
     if dst_weights is None:
-        share = 1.0 / (len(win.out_neighbors) + 1)
-        dst_weights = {d: share for d in win.out_neighbors}
+        share = 1.0 / (len(live_out) + 1)
+        dst_weights = {d: share for d in live_out}
         keep = share
     else:
-        keep = 1.0 - sum(dst_weights.values())
+        # shares aimed at dead ranks would be silently skipped by the
+        # degraded win_accumulate — keep them instead (mass conservation)
+        keep = 1.0 - sum(w for d, w in dst_weights.items()
+                         if d not in ctx.dead)
     win_accumulate(cur, name, dst_weights=dst_weights)
     win_set_exposed(name, cur * keep, p * keep)
     return win_update_then_collect(name)
@@ -1035,7 +1159,7 @@ class DistributedWinPutOptimizer:
 # ---------------------------------------------------------------------------
 
 
-def _spawn_worker(fn, r, nranks, job, args, q):
+def _spawn_worker(fn, r, nranks, job, args, q, tolerant=False):
     try:
         init(r, nranks, job)
         out = fn(r, nranks, *args)
@@ -1047,7 +1171,17 @@ def _spawn_worker(fn, r, nranks, job, args, q):
     # report BEFORE the teardown barrier: if a sibling died, the barrier
     # never completes and the parent reaps us after collecting results
     q.put((r, True, out))
-    barrier()
+    if tolerant:
+        # chaos runs: a sibling may have been killed, so the teardown
+        # barrier can never complete — bound it and proceed to shutdown
+        try:
+            barrier(timeout=_degraded.op_deadline_s())
+        except TypeError:
+            barrier()  # transport without timed barriers
+        except TimeoutError:
+            pass
+    else:
+        barrier()
     shutdown(unlink=(r == 0))
 
 
@@ -1057,7 +1191,8 @@ _spawn_counter = itertools.count()
 
 
 def spawn(fn, nranks: int, job: Optional[str] = None, timeout: float = 120.0,
-          args: Tuple = (), method: str = "spawn") -> List:
+          args: Tuple = (), method: str = "spawn",
+          allow_failures: bool = False) -> List:
     """Run ``fn(rank, size, *args)`` in ``nranks`` processes, each
     auto-``init``-ed; returns the per-rank return values in rank order.  The
     miniature in-process ``bfrun``: tests and notebooks use this, production
@@ -1068,6 +1203,11 @@ def spawn(fn, nranks: int, job: Optional[str] = None, timeout: float = 120.0,
     and an island owning its own runtime is the semantics anyway); "fork" is
     faster for JAX-free parents.  Under "spawn", ``fn`` must be a picklable
     top-level function.  Raises on any child failure.
+
+    ``allow_failures=True`` is the chaos-test mode: ranks that die (or
+    never report) yield ``None`` in the result list instead of raising,
+    and workers bound their teardown barrier so survivors exit cleanly
+    when a sibling was killed.
     """
     import multiprocessing as mp
 
@@ -1078,24 +1218,33 @@ def spawn(fn, nranks: int, job: Optional[str] = None, timeout: float = 120.0,
     mp_ctx = mp.get_context(method)
     q = mp_ctx.Queue()
     procs = [
-        mp_ctx.Process(target=_spawn_worker, args=(fn, r, nranks, job, args, q))
+        mp_ctx.Process(target=_spawn_worker,
+                       args=(fn, r, nranks, job, args, q, allow_failures))
         for r in range(nranks)
     ]
     for p in procs:
         p.start()
     results: Dict[int, object] = {}
     failures = []
-    for _ in range(nranks):
+    deadline = time.monotonic() + timeout
+    while len(results) + len(failures) < nranks:
         try:
-            r, ok, out = q.get(timeout=timeout)
+            r, ok, out = q.get(timeout=min(
+                0.25 if allow_failures else timeout,
+                max(0.05, deadline - time.monotonic())))
         except Exception:
-            failures.append("timeout waiting for island results")
+            if time.monotonic() < deadline:
+                if allow_failures and not any(p.is_alive() for p in procs):
+                    break  # killed ranks never report; everyone has exited
+                continue
+            if not allow_failures:
+                failures.append("timeout waiting for island results")
             break
         if ok:
             results[r] = out
         else:
             failures.append(f"rank {r}: {out}")
-    if failures:
+    if failures or (allow_failures and len(results) < nranks):
         # siblings of a failed rank may be stuck at the teardown barrier
         for p in procs:
             if p.is_alive():
@@ -1112,4 +1261,5 @@ def spawn(fn, nranks: int, job: Optional[str] = None, timeout: float = 120.0,
     shm_native.unlink_all(job, [])
     if failures:
         raise RuntimeError("island spawn failed:\n" + "\n".join(failures))
-    return [results[r] for r in range(nranks)]
+    # under allow_failures, killed ranks never reported: yield None
+    return [results.get(r) for r in range(nranks)]

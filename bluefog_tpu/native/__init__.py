@@ -136,8 +136,24 @@ def _declare_abi(lib: ctypes.CDLL) -> None:
         ]
         lib.bf_shm_job_barrier.restype = None
         lib.bf_shm_job_barrier.argtypes = [ctypes.c_void_p]
+        lib.bf_shm_job_barrier_timeout.restype = ctypes.c_int32
+        lib.bf_shm_job_barrier_timeout.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+        ]
+        lib.bf_shm_job_heartbeat.restype = None
+        lib.bf_shm_job_heartbeat.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.bf_shm_job_liveness.restype = ctypes.c_int64
+        lib.bf_shm_job_liveness.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.bf_shm_monotonic_ms.restype = ctypes.c_int64
+        lib.bf_shm_monotonic_ms.argtypes = []
         lib.bf_shm_job_mutex_acquire.restype = None
         lib.bf_shm_job_mutex_acquire.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.bf_shm_job_mutex_acquire_timeout.restype = ctypes.c_int32
+        lib.bf_shm_job_mutex_acquire_timeout.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ]
+        lib.bf_shm_job_mutex_break.restype = None
+        lib.bf_shm_job_mutex_break.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.bf_shm_job_mutex_release.restype = None
         lib.bf_shm_job_mutex_release.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.bf_shm_job_destroy.restype = None
@@ -187,6 +203,8 @@ def _declare_abi(lib: ctypes.CDLL) -> None:
         lib.bf_shm_win_exposed_offset.argtypes = [ctypes.c_void_p]
         lib.bf_shm_win_reset.restype = None
         lib.bf_shm_win_reset.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.bf_shm_win_force_drain.restype = None
+        lib.bf_shm_win_force_drain.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.bf_shm_win_expose.restype = None
         lib.bf_shm_win_expose.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_double,

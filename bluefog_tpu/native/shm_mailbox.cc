@@ -68,6 +68,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <ctime>
 
 #include <fcntl.h>
 #include <sched.h>
@@ -173,6 +174,19 @@ struct JobMutex {
   char pad[60];
 };
 
+// Per-rank liveness word (one cache line each, after the mutex array).
+// Each rank heartbeats its own word with a caller-supplied epoch stamp
+// (CLOCK_MONOTONIC milliseconds — system-wide on Linux, so peers can
+// compare a stamp against their own clock); a detector reads peers'
+// words and declares any rank whose stamp is older than its timeout
+// dead.  Plain shared-memory stores/loads with release/acquire — the
+// detector only ever needs "stamp visible, eventually", not ordering
+// against the mailbox payloads.
+struct LiveWord {
+  std::atomic<uint64_t> beat;
+  char pad[56];
+};
+
 struct Job {
   Segment seg;
   int64_t rank = 0;
@@ -182,7 +196,18 @@ struct Job {
     return reinterpret_cast<JobMutex*>(static_cast<char*>(seg.base) +
                                        align_up(sizeof(JobHeader), 64));
   }
+  LiveWord* live() {
+    return reinterpret_cast<LiveWord*>(
+        static_cast<char*>(seg.base) + align_up(sizeof(JobHeader), 64) +
+        nranks * static_cast<int64_t>(sizeof(JobMutex)));
+  }
 };
+
+int64_t monotonic_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+}
 
 // ---------------------------------------------------------------------------
 // window segment
@@ -395,7 +420,8 @@ void* bf_shm_job_create(const char* name, int64_t rank, int64_t nranks) {
   job->rank = rank;
   job->nranks = nranks;
   int64_t bytes = align_up(sizeof(JobHeader), 64) +
-                  nranks * static_cast<int64_t>(sizeof(JobMutex));
+                  nranks * static_cast<int64_t>(sizeof(JobMutex)) +
+                  nranks * static_cast<int64_t>(sizeof(LiveWord));
   bool creator = false;
   if (!segment_open(&job->seg, name, bytes,
                     offsetof(JobHeader, init_done), &creator)) {
@@ -422,6 +448,69 @@ void bf_shm_job_barrier(void* h) {
   }
 }
 
+// Timed sense-reversing barrier.  Returns 0 on release, -1 on timeout.
+// On timeout the caller's arrival is RETRACTED (CAS decrement) so later
+// barrier episodes are not corrupted; if the release races the retract,
+// the retract is abandoned and the call reports success.  timeout_ms < 0
+// waits forever (identical to bf_shm_job_barrier).
+int32_t bf_shm_job_barrier_timeout(void* h, int64_t timeout_ms) {
+  auto* job = static_cast<Job*>(h);
+  auto* hdr = job->hdr();
+  int64_t deadline = timeout_ms < 0 ? -1 : monotonic_ms() + timeout_ms;
+  uint64_t gen = hdr->generation.load(std::memory_order_acquire);
+  uint64_t arrived = hdr->arrived.fetch_add(1, std::memory_order_acq_rel) + 1;
+  if (arrived == static_cast<uint64_t>(job->nranks)) {
+    hdr->arrived.store(0, std::memory_order_relaxed);
+    hdr->generation.fetch_add(1, std::memory_order_acq_rel);
+    return 0;
+  }
+  while (hdr->generation.load(std::memory_order_acquire) == gen) {
+    if (deadline >= 0 && monotonic_ms() > deadline) {
+      // retract our arrival — unless the barrier released meanwhile, in
+      // which case arrived may already have been reset (observing 0 with
+      // gen unchanged means the last arriver is between its reset and its
+      // bump: the release is imminent, keep waiting for it)
+      uint64_t a = hdr->arrived.load(std::memory_order_relaxed);
+      for (;;) {
+        if (hdr->generation.load(std::memory_order_acquire) != gen) return 0;
+        if (a == 0) {
+          cpu_relax();
+          a = hdr->arrived.load(std::memory_order_relaxed);
+          continue;
+        }
+        if (hdr->arrived.compare_exchange_weak(a, a - 1,
+                                               std::memory_order_acq_rel,
+                                               std::memory_order_relaxed)) {
+          return -1;
+        }
+      }
+    }
+    cpu_relax();
+  }
+  return 0;
+}
+
+// Stamp my liveness word.  epoch_ms should be CLOCK_MONOTONIC milliseconds
+// (pass 0 to let the library stamp it).
+void bf_shm_job_heartbeat(void* h, int64_t epoch_ms) {
+  auto* job = static_cast<Job*>(h);
+  uint64_t stamp = epoch_ms > 0 ? static_cast<uint64_t>(epoch_ms)
+                                : static_cast<uint64_t>(monotonic_ms());
+  job->live()[job->rank].beat.store(stamp, std::memory_order_release);
+}
+
+// Read a rank's last heartbeat stamp (0 if it never beat).
+int64_t bf_shm_job_liveness(void* h, int64_t rank) {
+  auto* job = static_cast<Job*>(h);
+  return static_cast<int64_t>(
+      job->live()[rank].beat.load(std::memory_order_acquire));
+}
+
+// Current CLOCK_MONOTONIC milliseconds — the clock heartbeats are stamped
+// with, exported so the Python detector compares stamps against the same
+// system-wide timebase.
+int64_t bf_shm_monotonic_ms(void) { return monotonic_ms(); }
+
 void bf_shm_job_mutex_acquire(void* h, int64_t target_rank) {
   auto* job = static_cast<Job*>(h);
   auto& m = job->mutexes()[target_rank].locked;
@@ -431,6 +520,31 @@ void bf_shm_job_mutex_acquire(void* h, int64_t target_rank) {
     expected = 0;
     cpu_relax();
   }
+}
+
+// Timed mutex acquire: 0 on success, -1 on timeout.  timeout_ms < 0 waits
+// forever.  A mutex held by a dead rank can be reclaimed by the detector
+// via bf_shm_job_mutex_break.
+int32_t bf_shm_job_mutex_acquire_timeout(void* h, int64_t target_rank,
+                                         int64_t timeout_ms) {
+  auto* job = static_cast<Job*>(h);
+  auto& m = job->mutexes()[target_rank].locked;
+  int64_t deadline = timeout_ms < 0 ? -1 : monotonic_ms() + timeout_ms;
+  uint32_t expected = 0;
+  while (!m.compare_exchange_weak(expected, 1, std::memory_order_acquire,
+                                  std::memory_order_relaxed)) {
+    expected = 0;
+    if (deadline >= 0 && monotonic_ms() > deadline) return -1;
+    cpu_relax();
+  }
+  return 0;
+}
+
+// Forcibly release a mutex (dead-holder recovery; caller must have
+// established via the failure detector that the holder is gone).
+void bf_shm_job_mutex_break(void* h, int64_t target_rank) {
+  auto* job = static_cast<Job*>(h);
+  job->mutexes()[target_rank].locked.store(0, std::memory_order_release);
 }
 
 void bf_shm_job_mutex_release(void* h, int64_t target_rank) {
@@ -579,6 +693,38 @@ void bf_shm_win_reset(void* h, int64_t slot) {
     s->drained = s->version;
     s->p = 0.0;
   });
+}
+
+// Dead-writer recovery: force mailbox slot ``slot`` (of MY rank) into a
+// consistent drained state even if its writer died mid-deposit, leaving
+// the slot lock held and the wseq / per-chunk seqlocks odd.  Safe to call
+// ONLY after the failure detector has established the writer rank is gone
+// (no live writer will ever touch this slot again — each mailbox slot has
+// exactly one writer by construction).
+//
+// Mass conservation: ``slot_deposit`` advances ``p``/``version`` only
+// AFTER every chunk write, under the slot lock — so a writer that died
+// mid-deposit has committed ZERO mass; discarding the torn payload and
+// storing ``drained = version`` conserves the committed-mass ledger
+// exactly (model-checked: dead_writer_drain_model in
+// analysis/seqlock_model.py).
+void bf_shm_win_force_drain(void* h, int64_t slot) {
+  auto* win = static_cast<Window*>(h);
+  char* sl = win->mail(win->rank, slot);
+  auto* s = reinterpret_cast<SlotHeader*>(sl);
+  auto* cs = win->chunk_seqs(sl);
+  for (int64_t c = 0; c < win->nchunks; ++c) {
+    uint64_t q = cs[c].load(std::memory_order_relaxed);
+    if (q & 1) cs[c].store(q + 1, std::memory_order_release);
+  }
+  s->drained = s->version;
+  s->p = 0.0;
+  std::atomic_thread_fence(std::memory_order_release);
+  // even-ize the slot seqlock, advancing past any torn bracket so a
+  // reader that sampled the odd value retries and sees the drained state
+  uint64_t w = s->wseq.load(std::memory_order_relaxed);
+  s->wseq.store((w | 1) + 1, std::memory_order_release);
+  s->lock.store(0, std::memory_order_release);
 }
 
 // Publish my exposed tensor (what win_get by a neighbor observes).

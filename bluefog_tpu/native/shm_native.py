@@ -133,6 +133,26 @@ def pipeline_depth() -> int:
 #: lost-wakeup).
 BARRIER_RESET_BEFORE_RELEASE = True
 
+#: slot_deposit() advances ``p``/``version`` only AFTER every chunk write,
+#: under the slot lock.  This ordering is what makes the dead-writer drain
+#: sound: a writer that dies mid-deposit has committed ZERO mass, so
+#: bf_shm_win_force_drain() can discard the torn payload and store
+#: ``drained = version`` without losing any deposited mass (model-checked:
+#: dead_writer_drain_model — the commit-before-payload variant must lose
+#: mass and is a seeded-bug fixture).
+DEPOSIT_COMMITS_AFTER_PAYLOAD = True
+
+#: bf_shm_win_force_drain() (dead-writer recovery): even-ize the torn
+#: chunk seqlocks, store the drained marker, advance ``wseq`` past any
+#: torn bracket, clear the lock LAST.  Only legal once the failure
+#: detector has established the slot's (single) writer is gone.
+DEAD_WRITER_DRAIN_STEPS = (
+    "evenize_chunk_seqs",
+    "mark_drained",
+    "evenize_wseq",
+    "clear_lock",
+)
+
 
 def seg_name(job: str, suffix: str) -> str:
     """Sanitized POSIX shm object name (leading slash, [A-Za-z0-9_.-])."""
@@ -151,23 +171,60 @@ def _as_contiguous(array, dtype) -> np.ndarray:
 
 
 class NativeShmJob:
-    """Job-scope segment: sense-reversing barrier + per-rank mutexes."""
+    """Job-scope segment: sense-reversing barrier + per-rank mutexes +
+    per-rank heartbeat words (the shm leg of the failure detector)."""
 
     def __init__(self, job: str, rank: int, nranks: int):
         lib = get_lib()
         if lib is None:
             raise RuntimeError("native library unavailable")
         self._lib = lib
+        self.rank = int(rank)
+        self.nranks = int(nranks)
         self._name = seg_name(job, "job")
         self._h = lib.bf_shm_job_create(self._name.encode(), rank, nranks)
         if not self._h:
             raise RuntimeError(f"could not create shm job segment {self._name}")
 
-    def barrier(self) -> None:
-        self._lib.bf_shm_job_barrier(self._h)
+    def barrier(self, timeout: Optional[float] = None) -> None:
+        """Sense-reversing barrier.  With ``timeout`` (seconds) the wait is
+        bounded: on expiry the arrival is retracted (later episodes stay
+        consistent) and TimeoutError is raised."""
+        if timeout is None:
+            self._lib.bf_shm_job_barrier(self._h)
+            return
+        rc = self._lib.bf_shm_job_barrier_timeout(
+            self._h, int(timeout * 1000.0))
+        if rc != 0:
+            raise TimeoutError(
+                f"shm barrier timed out after {timeout:.3f}s "
+                f"(rank {self.rank} of {self.nranks})")
 
-    def mutex_acquire(self, rank: int) -> None:
-        self._lib.bf_shm_job_mutex_acquire(self._h, int(rank))
+    def heartbeat(self) -> None:
+        """Stamp my liveness word with CLOCK_MONOTONIC milliseconds."""
+        self._lib.bf_shm_job_heartbeat(self._h, 0)
+
+    def liveness(self, rank: int) -> float:
+        """A rank's last heartbeat stamp in seconds on the same
+        system-wide monotonic clock as :func:`time.monotonic` (0.0 if it
+        never beat)."""
+        return self._lib.bf_shm_job_liveness(self._h, int(rank)) / 1000.0
+
+    def mutex_acquire(self, rank: int,
+                      timeout: Optional[float] = None) -> None:
+        if timeout is None:
+            self._lib.bf_shm_job_mutex_acquire(self._h, int(rank))
+            return
+        rc = self._lib.bf_shm_job_mutex_acquire_timeout(
+            self._h, int(rank), int(timeout * 1000.0))
+        if rc != 0:
+            raise TimeoutError(
+                f"shm mutex {rank} not acquired within {timeout:.3f}s")
+
+    def mutex_break(self, rank: int) -> None:
+        """Forcibly release a mutex whose holder the failure detector has
+        declared dead."""
+        self._lib.bf_shm_job_mutex_break(self._h, int(rank))
 
     def mutex_release(self, rank: int) -> None:
         self._lib.bf_shm_job_mutex_release(self._h, int(rank))
@@ -399,6 +456,14 @@ class NativeShmWindow:
         del src
         self._lib.bf_shm_win_reset(self._h, int(slot))
 
+    def force_drain(self, slot: int, src=None) -> None:
+        """Dead-writer recovery on my mailbox ``slot``: force a consistent
+        drained state even if the writer died mid-deposit (lock held,
+        odd seqlocks).  Only call after the failure detector has declared
+        the slot's writer dead — see DEAD_WRITER_DRAIN_STEPS."""
+        del src
+        self._lib.bf_shm_win_force_drain(self._h, int(slot))
+
     def expose(self, array, p: float = 1.0) -> None:
         a = _as_contiguous(array, self.dtype)
         if a.nbytes != self.nbytes:
@@ -534,6 +599,23 @@ class ChunkRingMirror:
         self.wseq += 1
         self._pending = None
 
+    def force_drain(self) -> None:
+        """Dead-writer recovery (mirrors ``bf_shm_win_force_drain``):
+        discard any frozen mid-deposit state, even-ize the torn chunk
+        seqlocks and ``wseq``, and store the drained marker.  Because
+        ``version``/``p`` only advance AFTER every chunk commit
+        (DEPOSIT_COMMITS_AFTER_PAYLOAD), the torn deposit had committed
+        zero mass — the post-drain slot reads as logical zero and the
+        committed-mass ledger is conserved."""
+        self._pending = None
+        for c in range(self.nchunks):
+            if int(self.chunk_seq[c]) & 1:
+                self.chunk_seq[c] += 1
+        self.drained = self.version
+        self.p = 0.0
+        if self.wseq & 1:
+            self.wseq += 1
+
     def read(self, retries: int = 64):
         """Whole-slot bracketed read: retry while ``wseq`` is odd or moves
         across the copy.  Raises TimeoutError once the retry budget is
@@ -608,15 +690,21 @@ class _FallbackSegment:
 
 
 class FallbackShmJob:
-    """Barrier + mutexes over lockf.  Layout: [arrived u64][generation u64]
-    then one lock byte per rank (the mutex is the held lockf range)."""
+    """Barrier + mutexes + heartbeats over lockf.  Layout:
+    [arrived u64][generation u64], one lock byte per rank (the mutex is
+    the held lockf range), then one heartbeat u64 per rank."""
 
     def __init__(self, job: str, rank: int, nranks: int):
+        self.rank = int(rank)
         self.nranks = nranks
         path = os.path.join(_FALLBACK_DIR, seg_name(job, "job")[1:])
-        self._seg = _FallbackSegment(path, 16 + nranks)
+        self._seg = _FallbackSegment(path, 16 + nranks + 8 * nranks)
 
-    def barrier(self) -> None:
+    def _beat_off(self, rank: int) -> int:
+        return 16 + self.nranks + 8 * rank
+
+    def barrier(self, timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
         mm = self._seg._mm
         self._seg.lock(0, 16)
         gen = struct.unpack_from("<Q", mm, 8)[0]
@@ -634,10 +722,54 @@ class FallbackShmJob:
             self._seg.unlock(8, 8)
             if cur != gen:
                 return
+            if deadline is not None and time.monotonic() > deadline:
+                # retract the arrival so later episodes stay consistent
+                # (reset+bump are atomic under lock(0,16), so gen
+                # unchanged here implies our arrival is still counted)
+                self._seg.lock(0, 16)
+                try:
+                    if struct.unpack_from("<Q", mm, 8)[0] != gen:
+                        return  # released while we were timing out
+                    a = struct.unpack_from("<Q", mm, 0)[0]
+                    struct.pack_into("<Q", mm, 0, max(0, a - 1))
+                finally:
+                    self._seg.unlock(0, 16)
+                raise TimeoutError(
+                    f"shm barrier timed out after {timeout:.3f}s "
+                    f"(rank {self.rank} of {self.nranks})")
             time.sleep(0.0002)
 
-    def mutex_acquire(self, rank: int) -> None:
-        self._seg.lock(16 + rank, 1)
+    def heartbeat(self) -> None:
+        struct.pack_into("<Q", self._seg._mm, self._beat_off(self.rank),
+                         int(time.monotonic() * 1000.0))
+
+    def liveness(self, rank: int) -> float:
+        return struct.unpack_from(
+            "<Q", self._seg._mm, self._beat_off(rank))[0] / 1000.0
+
+    def mutex_acquire(self, rank: int,
+                      timeout: Optional[float] = None) -> None:
+        if timeout is None:
+            self._seg.lock(16 + rank, 1)
+            return
+        import fcntl
+
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                fcntl.lockf(self._seg._fd, fcntl.LOCK_EX | fcntl.LOCK_NB,
+                            1, 16 + rank)
+                return
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"shm mutex {rank} not acquired within "
+                        f"{timeout:.3f}s") from None
+                time.sleep(0.0005)
+
+    def mutex_break(self, rank: int) -> None:
+        # lockf ranges die with their holder process — nothing to break
+        pass
 
     def mutex_release(self, rank: int) -> None:
         self._seg.unlock(16 + rank, 1)
@@ -836,6 +968,11 @@ class FallbackShmWindow:
             struct.pack_into("<Qd", mm, off, version, 0.0)
         finally:
             self._unlock(idx)
+
+    def force_drain(self, slot: int, src=None) -> None:
+        """Dead-writer recovery.  lockf ranges die with their holder, so
+        a dead writer cannot leave this slot locked — reset suffices."""
+        self.reset(slot, src=src)
 
     def unlink_segments(self) -> None:
         if self.rank == 0:

@@ -39,6 +39,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from bluefog_tpu.common.logging_util import logger
+from bluefog_tpu.resilience.detector import PeerTimeoutError
 
 # ops
 _OP_WRITE = 1          # deposit into (my) mail slot: mode 0 put, 1 accumulate
@@ -48,8 +49,24 @@ _OP_MUTEX_REL = 4
 _OP_BARRIER = 5        # rank-0 only
 _OP_REGISTER = 6       # rank-0 only: register rank -> addr, get table when full
 _OP_PING = 7
+_OP_BARRIER_T = 8      # rank-0 only: timed barrier, timeout rides in p
+_OP_HEARTBEAT = 9      # rank-0 only: renew rank `slot`'s lease
+_OP_LIVENESS = 10      # rank-0 only: age of rank `slot`'s lease (in p)
 
 _HDR = struct.Struct("<iiiiqd")  # op, win_id, slot, mode, nbytes, p
+
+
+def peer_timeout_s() -> Optional[float]:
+    """Deadline for any single request/response round trip to a peer
+    (``BFTPU_PEER_TIMEOUT_S``; <= 0 disables, restoring unbounded waits).
+    The default is generous: mutex and barrier waits legitimately block
+    while other ranks compute — the deadline exists to unstick survivors
+    from a DEAD peer, not to police slow ones."""
+    try:
+        t = float(os.environ.get("BFTPU_PEER_TIMEOUT_S", "120"))
+    except ValueError:
+        t = 120.0
+    return t if t > 0 else None
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -129,6 +146,13 @@ class _Server:
         # registry (rank 0 only)
         self.reg_cond = threading.Condition()
         self.registry: Dict[int, str] = {}
+        # liveness leases (rank-0 coordinator only): rank -> last-renewal
+        # stamp on THIS server's monotonic clock.  Ranks heartbeat the
+        # coordinator, survivors query lease AGE (clock-transportable,
+        # unlike the raw stamp) — the tcp analogue of the shm transport's
+        # per-rank liveness words.
+        self.lease_lock = threading.Lock()
+        self.leases: Dict[int, float] = {}
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.sock.bind((host, port))
@@ -224,6 +248,39 @@ class _Server:
                             f"{k} {v}" for k, v in sorted(self.registry.items())
                         ).encode()
                     _send_msg(conn, op, payload=table)
+                elif op == _OP_BARRIER_T:
+                    # timed barrier: the COORDINATOR owns the retraction
+                    # (client-side socket timeouts cannot un-arrive), so a
+                    # timed-out rank leaves the count exactly as if it had
+                    # never arrived and a later barrier is unharmed
+                    timed_out = 0
+                    with self.bar_cond:
+                        gen = self.bar_gen
+                        self.bar_count += 1
+                        if self.bar_count == self.nranks:
+                            self.bar_count = 0
+                            self.bar_gen += 1
+                            self.bar_cond.notify_all()
+                        else:
+                            deadline = time.monotonic() + max(0.0, p)
+                            while self.bar_gen == gen:
+                                left = deadline - time.monotonic()
+                                if left <= 0:
+                                    break
+                                self.bar_cond.wait(left)
+                            if self.bar_gen == gen:
+                                self.bar_count -= 1  # retract arrival
+                                timed_out = 1
+                    _send_msg(conn, op, mode=timed_out)
+                elif op == _OP_HEARTBEAT:
+                    with self.lease_lock:
+                        self.leases[slot] = time.monotonic()
+                    _send_msg(conn, op)
+                elif op == _OP_LIVENESS:
+                    with self.lease_lock:
+                        stamp = self.leases.get(slot, 0.0)
+                    age = (time.monotonic() - stamp) if stamp > 0 else -1.0
+                    _send_msg(conn, op, p=age)
                 elif op == _OP_PING:
                     _send_msg(conn, op)
                 else:
@@ -264,14 +321,27 @@ class _Peers:
             if conn is None:
                 host, port = self.table[rank].rsplit(":", 1)
                 conn = socket.create_connection((host, int(port)), timeout=60)
-                # the setup timeout must NOT persist: mutex/barrier waits
-                # legitimately block for arbitrary lengths
-                conn.settimeout(None)
+                # a bounded deadline replaces the old unbounded wait: a
+                # request to a DEAD peer must eventually surface as a
+                # PeerTimeoutError naming the rank, not a silent hang
+                conn.settimeout(peer_timeout_s())
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 self.conns[rank] = conn
             try:
                 _send_msg(conn, op, win_id, slot, mode, p, payload)
                 return _recv_msg(conn)
+            except socket.timeout as e:
+                # half-done exchange: the stream is unusable (a late reply
+                # would be mis-paired with the next request) — evict it
+                self.conns.pop(rank, None)
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                raise PeerTimeoutError(
+                    f"rank {rank} did not respond to op {op} within "
+                    f"{peer_timeout_s()}s (set BFTPU_PEER_TIMEOUT_S to "
+                    f"adjust)", rank=rank) from e
             except (ConnectionError, OSError):
                 # evict the dead socket so the NEXT request reconnects
                 # instead of failing forever on a cached corpse
@@ -326,12 +396,20 @@ class _JobRuntime:
                 if time.time() > deadline:
                     raise
                 time.sleep(0.05)
-        # registration/barrier replies wait on OTHER ranks — no timeout
-        coord_conn.settimeout(None)
+        # registration/barrier replies wait on OTHER ranks, but never
+        # forever: a dead sibling must surface as PeerTimeoutError(-1)
+        # within the configured deadline, not hang the job
+        coord_conn.settimeout(peer_timeout_s())
         coord_conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         _send_msg(coord_conn, _OP_REGISTER, slot=rank, payload=my_addr.encode())
         _, _, _, _, _, table_raw = _recv_msg(coord_conn)
         self._coord_conn = coord_conn  # kept open: barrier rides on it
+        self._coord_addr = (chost, int(cport))
+        # leases ride a SEPARATE lazily-created coordinator connection: the
+        # heartbeat thread must keep renewing while the main thread blocks
+        # inside a barrier on _coord_conn
+        self._lease_conn: Optional[socket.socket] = None
+        self._lease_lock = threading.Lock()
         table = {}
         for line in table_raw.decode().splitlines():
             k, v = line.split()
@@ -358,6 +436,11 @@ class _JobRuntime:
                 rt._coord_conn.close()
             except OSError:
                 pass
+            if rt._lease_conn is not None:
+                try:
+                    rt._lease_conn.close()
+                except OSError:
+                    pass
             rt.server.stop()
             if rt._coord_server is not None:
                 rt._coord_server.stop()
@@ -371,10 +454,56 @@ class _JobRuntime:
             self._next_win += 1
         return self._win_ids[name]
 
-    def barrier(self):
+    def barrier(self, timeout: Optional[float] = None):
         with self.peers.locks.setdefault(-1, threading.Lock()):
-            _send_msg(self._coord_conn, _OP_BARRIER)
-            _recv_msg(self._coord_conn)
+            mode = 0
+            try:
+                if timeout is None:
+                    _send_msg(self._coord_conn, _OP_BARRIER)
+                    _recv_msg(self._coord_conn)
+                else:
+                    # the coordinator owns the timed wait AND the arrival
+                    # retraction; the socket deadline only covers the
+                    # round trip on top of it
+                    old = self._coord_conn.gettimeout()
+                    self._coord_conn.settimeout(float(timeout) + 30.0)
+                    try:
+                        _send_msg(self._coord_conn, _OP_BARRIER_T,
+                                  p=float(timeout))
+                        _, _, _, mode, _, _ = _recv_msg(self._coord_conn)
+                    finally:
+                        self._coord_conn.settimeout(old)
+            except socket.timeout as e:
+                # NB socket.timeout IS TimeoutError (py3.10): only socket
+                # waits happen inside this try, so the clause is unambiguous
+                raise PeerTimeoutError(
+                    "coordinator (rank 0) did not answer the barrier "
+                    "within its deadline", rank=-1) from e
+            if mode:
+                raise TimeoutError(
+                    f"barrier timed out after {timeout}s (rank {self.rank})")
+
+    def _lease_request(self, op: int, rank: int) -> float:
+        """One heartbeat/liveness round trip to the coordinator (own
+        connection + lock: must work while barrier blocks _coord_conn)."""
+        with self._lease_lock:
+            conn = self._lease_conn
+            if conn is None:
+                conn = socket.create_connection(self._coord_addr, timeout=5)
+                conn.settimeout(peer_timeout_s())
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._lease_conn = conn
+            try:
+                _send_msg(conn, op, slot=rank)
+                _, _, _, _, age, _ = _recv_msg(conn)
+                return age
+            except (socket.timeout, ConnectionError, OSError):
+                self._lease_conn = None
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                raise
 
 
 class TcpShmJob:
@@ -385,14 +514,28 @@ class TcpShmJob:
         self.job = job
         self.rank = rank
 
-    def barrier(self) -> None:
-        self.rt.barrier()
+    def barrier(self, timeout: Optional[float] = None) -> None:
+        self.rt.barrier(timeout=timeout)
 
     def mutex_acquire(self, rank: int) -> None:
         self.rt.peers.request(rank, _OP_MUTEX_ACQ)
 
     def mutex_release(self, rank: int) -> None:
         self.rt.peers.request(rank, _OP_MUTEX_REL)
+
+    # -- liveness leases (coordinator-mediated; see FailureDetector) -------
+    def heartbeat(self) -> None:
+        """Renew my lease at the rank-0 coordinator."""
+        self.rt._lease_request(_OP_HEARTBEAT, self.rank)
+
+    def liveness(self, rank: int) -> float:
+        """Last lease renewal of ``rank``, mapped onto MY monotonic clock
+        (0.0 = never renewed).  The coordinator reports lease AGE — ages
+        transport across hosts; raw stamps do not."""
+        age = self.rt._lease_request(_OP_LIVENESS, rank)
+        if age < 0:
+            return 0.0
+        return max(0.0, time.monotonic() - age)
 
     def close(self, unlink: bool = False) -> None:
         del unlink

@@ -1,0 +1,50 @@
+"""Failure detection, topology healing, and degraded-step gossip.
+
+The whole point of decentralized gossip (BlueFog, arXiv:2111.04287) is
+that there is no single coordinator to lose — this subsystem makes the
+island runtime live up to that: a heartbeat **failure detector**
+piggybacked on the job segment (shm: per-rank epoch-stamped liveness
+words; tcp: coordinator-mediated leases), **topology healing** that
+re-derives a doubly-stochastic survivor topology and recompiles the
+shift-class plan when ranks die, **degraded-step semantics** (deadlines
+with retry/backoff; mass-conserving weight renormalization on neighbor
+loss, so push-sum stays correct), and a **fault-injection harness**
+for the chaos e2e tests.
+
+Push-sum-style algorithms are provably robust on time-varying directed
+graphs (Nedić & Olshevsky) — the math already tolerates lost neighbors;
+these modules make the runtime tolerate them too.  See
+docs/RESILIENCE.md for the full contract.
+"""
+
+from bluefog_tpu.resilience.detector import (
+    FailureDetector,
+    PeerTimeoutError,
+    failure_timeout_s,
+    heartbeat_interval_s,
+)
+from bluefog_tpu.resilience.degraded import (
+    DeadlineExceeded,
+    op_deadline_s,
+    renormalize_weights,
+    with_deadline,
+)
+from bluefog_tpu.resilience.healing import (
+    HealedTopology,
+    heal_topology,
+    healed_weight_matrix,
+)
+
+__all__ = [
+    "FailureDetector",
+    "PeerTimeoutError",
+    "failure_timeout_s",
+    "heartbeat_interval_s",
+    "DeadlineExceeded",
+    "op_deadline_s",
+    "renormalize_weights",
+    "with_deadline",
+    "HealedTopology",
+    "heal_topology",
+    "healed_weight_matrix",
+]

@@ -1,0 +1,115 @@
+"""Fault-injection harness for the resilience e2e tests.
+
+Faults are injected two ways:
+
+- **from outside**: :func:`kill`, :func:`suspend`, :func:`resume` act
+  on a worker pid (SIGKILL / SIGSTOP / SIGCONT) — the test process
+  steers its spawned islands;
+- **from inside**: workers call :func:`checkpoint(rank, step)` at
+  instrumented points; a schedule published through env vars
+  (``BFTPU_CHAOS_KILL_RANK`` / ``BFTPU_CHAOS_KILL_STEP`` /
+  ``BFTPU_CHAOS_DELAY_S``) makes the matching rank kill itself with
+  SIGKILL mid-op — deterministic death at a protocol-relevant point
+  (e.g. between the expose and the deposit of a win_put), which no
+  external signal can time reliably.
+
+Mailbox corruption for protocol tests goes through
+:func:`corrupt_chunk` on a :class:`~bluefog_tpu.native.shm_native.
+ChunkRingMirror` — it freezes a deposit mid-chunk exactly the way a
+dead writer does, so the dead-writer drain path is exercised without
+an actual process death.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Optional
+
+__all__ = [
+    "kill",
+    "suspend",
+    "resume",
+    "kill_self",
+    "checkpoint",
+    "schedule_kill",
+    "clear_schedule",
+    "corrupt_chunk",
+]
+
+_KILL_RANK = "BFTPU_CHAOS_KILL_RANK"
+_KILL_STEP = "BFTPU_CHAOS_KILL_STEP"
+_DELAY_S = "BFTPU_CHAOS_DELAY_S"
+
+
+def kill(pid: int) -> None:
+    """SIGKILL a worker process (no cleanup runs — the hard failure)."""
+    os.kill(pid, signal.SIGKILL)
+
+
+def suspend(pid: int) -> None:
+    """SIGSTOP a worker — it looks dead to the detector while stopped
+    but resumes mid-instruction on :func:`resume` (the gray failure)."""
+    os.kill(pid, signal.SIGSTOP)
+
+
+def resume(pid: int) -> None:
+    os.kill(pid, signal.SIGCONT)
+
+
+def kill_self() -> None:
+    """Immediate SIGKILL of the calling process: no atexit, no teardown
+    barrier, no segment unlink — exactly what rank death looks like to
+    the survivors."""
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def schedule_kill(env: dict, rank: int, step: int,
+                  delay_s: float = 0.0) -> dict:
+    """Publish a kill schedule into an env mapping (pass to the worker
+    spawn): rank ``rank`` dies at its ``step``-th matching checkpoint."""
+    env[_KILL_RANK] = str(int(rank))
+    env[_KILL_STEP] = str(int(step))
+    if delay_s:
+        env[_DELAY_S] = str(float(delay_s))
+    return env
+
+
+def clear_schedule() -> None:
+    for k in (_KILL_RANK, _KILL_STEP, _DELAY_S):
+        os.environ.pop(k, None)
+
+
+_counters = {}
+
+
+def checkpoint(rank: int, tag: str = "step") -> None:
+    """Chaos instrumentation point: count invocations per (rank, tag)
+    and execute the scheduled fault when the counter hits the scheduled
+    step.  A no-op (two dict lookups) when no schedule is set."""
+    kill_rank = os.environ.get(_KILL_RANK)
+    if kill_rank is None:
+        return
+    delay = os.environ.get(_DELAY_S)
+    if delay:
+        time.sleep(float(delay))
+    if int(kill_rank) != int(rank):
+        return
+    key = (int(rank), tag)
+    n = _counters.get(key, 0) + 1
+    _counters[key] = n
+    if n >= int(os.environ.get(_KILL_STEP, "1")):
+        kill_self()
+
+
+def corrupt_chunk(mirror, data: Optional[bytes] = None,
+                  tear_at: int = 0) -> None:
+    """Freeze a deposit mid-chunk on a ChunkRingMirror: chunk
+    ``tear_at`` is left odd with half its bytes stored and ``wseq``
+    stays odd — the exact state a dead writer leaves behind.  Recover
+    with ``mirror.force_drain()`` (or resume with
+    ``mirror.complete_write()``)."""
+    if data is None:
+        data = os.urandom(mirror.nbytes)
+    mirror.begin_torn_write(data, p=1.0, tear_at=tear_at)

@@ -1,0 +1,159 @@
+"""Heartbeat failure detector, piggybacked on the job transport.
+
+Every island rank stamps a per-rank liveness word with the system-wide
+monotonic clock; the detector declares a peer dead once its stamp is
+older than the configured timeout.  The liveness word lives wherever
+the job segment lives, so the detector rides the existing transports:
+
+- **shm**: one cache line per rank in the native job segment
+  (``bf_shm_job_heartbeat`` / ``bf_shm_job_liveness``), or the
+  heartbeat u64 array in the lockf fallback segment;
+- **tcp**: coordinator-mediated leases — each rank heartbeats the
+  rank-0 coordinator, which serves the lease table back to
+  ``liveness()`` queries (see native/tcp_transport.py).
+
+The job object is duck-typed: any transport exposing ``heartbeat()``
+and ``liveness(rank) -> float`` (seconds on ``time.monotonic``'s
+clock; 0.0 = never beat) participates.  A transport without the
+surface degrades to "everyone is alive" — resilience is opt-in per
+transport, never a crash.
+
+Env knobs:
+
+- ``BFTPU_HEARTBEAT_INTERVAL_S`` (default 0.05) — background beat
+  period;
+- ``BFTPU_FAILURE_TIMEOUT_S`` (default 2.0) — stamp age past which a
+  peer is declared dead.  Ranks that have NEVER beaten get a startup
+  grace of the same length measured from detector construction.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional, Set
+
+__all__ = [
+    "PeerTimeoutError",
+    "FailureDetector",
+    "heartbeat_interval_s",
+    "failure_timeout_s",
+]
+
+
+class PeerTimeoutError(RuntimeError):
+    """A peer rank failed to respond within its deadline.
+
+    ``rank`` names the unresponsive peer (-1 = the coordinator).
+    Raised by the tcp transport's bounded waits and by degraded-step
+    retries once the retry budget is exhausted.
+    """
+
+    def __init__(self, message: str, rank: int = -1):
+        super().__init__(message)
+        self.rank = rank
+
+
+def heartbeat_interval_s() -> float:
+    try:
+        return float(os.environ.get("BFTPU_HEARTBEAT_INTERVAL_S", "0.05"))
+    except ValueError:
+        return 0.05
+
+
+def failure_timeout_s() -> float:
+    try:
+        return float(os.environ.get("BFTPU_FAILURE_TIMEOUT_S", "2.0"))
+    except ValueError:
+        return 2.0
+
+
+class FailureDetector:
+    """Background heartbeater + liveness judge over a job transport."""
+
+    def __init__(self, job, rank: int, nranks: int,
+                 timeout: Optional[float] = None,
+                 interval: Optional[float] = None):
+        self._job = job
+        self.rank = int(rank)
+        self.nranks = int(nranks)
+        self.timeout = failure_timeout_s() if timeout is None else timeout
+        self.interval = (heartbeat_interval_s() if interval is None
+                         else interval)
+        self._supported = (hasattr(job, "heartbeat")
+                           and hasattr(job, "liveness"))
+        self._born = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._declared: Set[int] = set()
+        self._lock = threading.Lock()
+        self.beat()
+
+    @property
+    def supported(self) -> bool:
+        return self._supported
+
+    def beat(self) -> None:
+        """One heartbeat now (the background thread calls this; ops on
+        the hot path may too — it is one relaxed store)."""
+        if self._supported:
+            try:
+                self._job.heartbeat()
+            except Exception:
+                pass
+
+    def start(self) -> "FailureDetector":
+        if self._thread is None and self._supported:
+            self._thread = threading.Thread(
+                target=self._run, name="bf-heartbeat", daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.beat()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+            self._thread = None
+
+    def is_alive(self, rank: int) -> bool:
+        if not self._supported or rank == self.rank:
+            return True
+        with self._lock:
+            if rank in self._declared:
+                return False
+        try:
+            stamp = float(self._job.liveness(rank))
+        except Exception:
+            return True
+        now = time.monotonic()
+        if stamp <= 0.0:
+            # never beat: startup grace measured from detector birth
+            return now - self._born <= self.timeout
+        return now - stamp <= self.timeout
+
+    def dead_ranks(self) -> Set[int]:
+        """All ranks currently considered dead.  A rank once declared
+        dead STAYS dead (the healing rules assume monotone membership
+        loss; a restarted rank must rejoin as a new job)."""
+        dead = {r for r in range(self.nranks)
+                if r != self.rank and not self.is_alive(r)}
+        with self._lock:
+            self._declared |= dead
+            return set(self._declared)
+
+    def declare_dead(self, rank: int) -> None:
+        """Externally assert a rank is dead (e.g. the tcp transport saw
+        its connection reset, or a test injected the failure)."""
+        with self._lock:
+            self._declared.add(int(rank))
+
+    def __enter__(self) -> "FailureDetector":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

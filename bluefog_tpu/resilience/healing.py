@@ -1,0 +1,129 @@
+"""Topology healing: rebuild a valid gossip topology over the survivors.
+
+Given a dead-rank set, the healing rule is:
+
+1. take the subgraph INDUCED by the survivors (every edge whose two
+   endpoints both survived);
+2. SYMMETRIZE it (add the reverse of every surviving edge) — directed
+   topologies like the one-directional exponential graph lose in/out
+   balance when ranks are excised, and only a symmetric neighbor
+   relation admits a doubly-stochastic Metropolis–Hastings weighting;
+3. if the result is not strongly connected (or has isolated survivors),
+   add a ring over the sorted survivors — gossip averaging needs a
+   positive spectral gap, which needs connectivity;
+4. relabel the sorted survivors to 0..m-1 (``compile_plan`` requires
+   contiguous node ids) and keep the local↔global maps;
+5. re-weight with Metropolis–Hastings
+   (``w_uv = 1/(1 + max(deg(u), deg(v)))``), which on a symmetric graph
+   yields a DOUBLY stochastic mixing matrix — the property that makes
+   plain gossip averaging converge to the true average on the survivor
+   set — and recompile the shift-class plan.
+
+The healed plan drives both the SPMD emulation (windows.py) and the
+analysis rules; the island runtime applies the same membership change
+in place via degraded weights (see resilience/degraded.py) without
+reallocating its shm segments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, Tuple
+
+import networkx as nx
+import numpy as np
+
+from bluefog_tpu import topology_util
+from bluefog_tpu.core.plan import CommPlan, compile_plan
+
+__all__ = ["HealedTopology", "heal_topology", "healed_weight_matrix"]
+
+
+@dataclasses.dataclass(frozen=True)
+class HealedTopology:
+    """A survivor topology with its plan and local↔global rank maps."""
+
+    survivors: Tuple[int, ...]   # sorted global ranks still alive
+    dead: Tuple[int, ...]        # sorted global ranks excised
+    topology: nx.DiGraph         # relabeled 0..m-1, MH-weighted
+    plan: CommPlan               # compiled over the relabeled topology
+    to_local: Dict[int, int]     # global rank -> local node id
+    to_global: Tuple[int, ...]   # local node id -> global rank
+    reconnected: bool            # ring edges were added for connectivity
+
+    @property
+    def size(self) -> int:
+        return len(self.survivors)
+
+    def local_in_neighbors(self, global_rank: int) -> Tuple[int, ...]:
+        """Global ranks of ``global_rank``'s in-neighbors in the healed
+        topology."""
+        v = self.to_local[global_rank]
+        return tuple(sorted(self.to_global[u]
+                            for u in self.topology.predecessors(v)))
+
+
+def _symmetrized_induced(topo: nx.DiGraph,
+                         survivors: Iterable[int]) -> nx.DiGraph:
+    keep = set(survivors)
+    G = nx.DiGraph()
+    G.add_nodes_from(sorted(keep))
+    for u, v in topo.edges:
+        if u == v or u not in keep or v not in keep:
+            continue
+        G.add_edge(u, v)
+        G.add_edge(v, u)
+    return G
+
+
+def heal_topology(topo: nx.DiGraph, dead: Iterable[int]) -> HealedTopology:
+    """Excise ``dead`` from ``topo`` and return a connected, MH-weighted,
+    doubly-stochastic survivor topology with a freshly compiled plan.
+
+    Raises ValueError if every rank is dead or ``dead`` contains ranks
+    not in the topology.
+    """
+    nodes = set(int(n) for n in topo.nodes)
+    dead_set = set(int(r) for r in dead)
+    if not dead_set <= nodes:
+        raise ValueError(
+            f"dead ranks {sorted(dead_set - nodes)} not in topology")
+    survivors = tuple(sorted(nodes - dead_set))
+    if not survivors:
+        raise ValueError("no survivors: every rank is dead")
+
+    G = _symmetrized_induced(topo, survivors)
+    reconnected = False
+    m = len(survivors)
+    if m > 1 and not nx.is_strongly_connected(G):
+        # restore connectivity (and a positive spectral gap) with a
+        # bidirectional ring over the sorted survivors
+        reconnected = True
+        for i in range(m):
+            u, v = survivors[i], survivors[(i + 1) % m]
+            if u != v:
+                G.add_edge(u, v)
+                G.add_edge(v, u)
+
+    to_global = survivors
+    to_local = {g: i for i, g in enumerate(survivors)}
+    H = nx.relabel_nodes(G, to_local, copy=True)
+    topology_util.MetropolisHastingsWeights(H)
+    H.graph["healed_from"] = tuple(sorted(dead_set))
+
+    plan = compile_plan(H)
+    return HealedTopology(
+        survivors=survivors,
+        dead=tuple(sorted(dead_set)),
+        topology=H,
+        plan=plan,
+        to_local=to_local,
+        to_global=to_global,
+        reconnected=reconnected,
+    )
+
+
+def healed_weight_matrix(healed: HealedTopology) -> np.ndarray:
+    """The healed mixing matrix W (m × m, local ids): row- AND
+    column-stochastic by construction (symmetric graph + MH weights)."""
+    return healed.plan.mixing_matrix()

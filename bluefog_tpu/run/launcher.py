@@ -196,14 +196,30 @@ def _kill_remote(ranks, sig="TERM"):
             )
 
 
+def _launch_grace_s() -> float:
+    """How long surviving ranks get to finish after a sibling dies
+    (``BFTPU_LAUNCH_GRACE_S``, default 5).  With the resilience layer a
+    survivor can heal the topology and run to completion — killing it the
+    instant a sibling fails would forfeit that; 0 restores the old
+    immediate teardown."""
+    try:
+        return max(0.0, float(os.environ.get("BFTPU_LAUNCH_GRACE_S", "5")))
+    except ValueError:
+        return 5.0
+
+
 def _supervise(ranks, timeout: float) -> int:
     """Poll ALL children until done: rank k can die while rank 0 blocks in
     the distributed rendezvous waiting for it — an in-order wait would only
     report the failure after jax's multi-minute init timeout.  On the first
-    nonzero exit (or on ``--timeout`` expiry) the rest are torn down,
-    including the REAL processes behind ssh clients."""
+    nonzero exit the survivors get a grace period (they may heal and
+    finish — see docs/RESILIENCE.md), then the rest are torn down,
+    including the REAL processes behind ssh clients; the FIRST failing
+    exit code is what propagates.  ``--timeout`` expiry tears down
+    immediately."""
     code = 0
     deadline = time.monotonic() + timeout if timeout else None
+    grace_deadline = None
     live = list(ranks)
 
     def teardown(sig=signal.SIGTERM):
@@ -219,7 +235,21 @@ def _supervise(ranks, timeout: float) -> int:
                 live.remove(rk)
                 if rc != 0 and code == 0:
                     code = rc
-                    teardown()
+                    grace = _launch_grace_s()
+                    if grace > 0 and live:
+                        grace_deadline = time.monotonic() + grace
+                        print(
+                            f"bftpu-run: a rank failed (exit {rc}); "
+                            f"giving {len(live)} surviving rank(s) "
+                            f"{grace:g}s to finish", file=sys.stderr)
+                    else:
+                        teardown()
+            if live and grace_deadline is not None \
+                    and time.monotonic() > grace_deadline:
+                print(f"bftpu-run: grace expired; killing {len(live)} "
+                      f"surviving rank(s)", file=sys.stderr)
+                grace_deadline = None
+                teardown()
             if live and deadline is not None and time.monotonic() > deadline:
                 print(f"bftpu-run: timeout after {timeout:g}s; killing "
                       f"{len(live)} live rank(s)", file=sys.stderr)

@@ -38,6 +38,7 @@ __all__ = [
     "FullyConnectedGraph",
     "IsRegularGraph",
     "IsTopologyEquivalent",
+    "MetropolisHastingsWeights",
     "GetRecvWeights",
     "GetSendWeights",
     "GetWeightMatrix",
@@ -191,9 +192,24 @@ def StarGraph(size: int, center_rank: int = 0) -> nx.DiGraph:
     return _finalize(G, weighted=True)
 
 
-def _metropolis_hastings_weights(G: nx.DiGraph) -> None:
+def MetropolisHastingsWeights(G: nx.DiGraph) -> nx.DiGraph:
+    """Re-weight every edge in place with the Metropolis–Hastings rule
+    ``w_uv = 1 / (1 + max(deg(u), deg(v)))`` and return ``G``.
+
+    On a symmetric graph this yields a doubly stochastic mixing matrix
+    regardless of how irregular the degree distribution is — the same
+    rule the irregular constructors (star, mesh) apply, and the one
+    :func:`bluefog_tpu.resilience.healing.heal_topology` uses to restore
+    double stochasticity after ranks are excised.
+    """
     for u, v in G.edges:
         G[u][v]["weight"] = 1.0 / (1 + max(G.in_degree(u), G.in_degree(v)))
+    G.graph["weighted"] = True
+    return G
+
+
+# internal alias kept for the constructors above
+_metropolis_hastings_weights = MetropolisHastingsWeights
 
 
 def MeshGrid2DGraph(size: int, shape: Optional[Tuple[int, int]] = None) -> nx.DiGraph:

@@ -72,6 +72,7 @@ __all__ = [
     "turn_off_win_ops_with_associated_p",
     "record_win_ops",
     "note_win_op",
+    "degraded_update_weights",
 ]
 
 WeightsArg = Union[None, Sequence[Dict[int, float]]]
@@ -667,6 +668,34 @@ def _update_weights(win: _Window, self_weight, neighbor_weights):
         else:
             swvec[d] = float(self_weight[d])
     return wmat, swvec
+
+
+def degraded_update_weights(plan: CommPlan, dead):
+    """Per-rank ``(self_weights, neighbor_weights)`` for :func:`win_update`
+    with the ranks in ``dead`` excised from the combine.
+
+    Each survivor drops its dead in-neighbors and ABSORBS their compiled
+    plan weight into its own self weight, so every row total is preserved
+    exactly: convex rows stay convex and push-sum collect rows stay
+    mass-conserving — the island runtime's degraded-combine rule
+    (resilience/degraded.py), made available to the SPMD emulation for
+    fault-injected gossip.  Dead ranks' own rows are left untouched
+    (their state no longer participates)."""
+    dead = set(int(r) for r in dead)
+    W = plan.mixing_matrix()
+    self_w: List[float] = []
+    neighbor_w: List[Dict[int, float]] = []
+    for d in range(plan.size):
+        sw = float(W[d, d])
+        nw = {}
+        for s in plan.in_neighbors[d]:
+            if d not in dead and s in dead:
+                sw += float(W[d, s])
+            else:
+                nw[s] = float(W[d, s])
+        self_w.append(sw)
+        neighbor_w.append(nw)
+    return self_w, neighbor_w
 
 
 def _combine(self_tensor, mail, p_self, p_mail, wmat, swvec, *, wdt, with_p):

@@ -1,0 +1,474 @@
+"""Resilience subsystem: failure detection, topology healing, degraded
+gossip, and fault-injected end-to-end runs (docs/RESILIENCE.md).
+
+The reference BlueFog is fail-stop — one dead rank aborts the MPI job.
+These tests pin the opposite contract: survivors detect the death
+(heartbeat liveness words / coordinator leases), heal the topology
+(induced subgraph -> symmetrize -> ring-reconnect -> Metropolis–Hastings
+re-weighting -> recompiled plan), force-drain the corpse's mailbox slots
+(losing no committed mass — the dead-writer-drain theorem, model-checked
+in bluefog_tpu.analysis.seqlock_model), and keep gossiping with
+mass-conserving degraded combine rows, with every blocking wait bounded
+by a deadline.
+"""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bluefog_tpu import islands, topology_util
+from bluefog_tpu.analysis import plan_rules, resilience_rules
+from bluefog_tpu.analysis.engine import Report
+from bluefog_tpu.native import shm_native
+from bluefog_tpu.resilience import chaos, degraded, healing
+from bluefog_tpu.resilience.detector import FailureDetector, PeerTimeoutError
+from bluefog_tpu.windows import degraded_update_weights
+
+# ---------------------------------------------------------------------------
+# topology healing: pure properties over the whole named corpus
+# ---------------------------------------------------------------------------
+
+
+def test_healed_topology_corpus():
+    """Every named topology x sizes 4..16 x dead-rank sets: the healed
+    survivor plan is doubly stochastic, mixing (positive spectral gap),
+    covers the healed edge set, and fully excises the dead."""
+    report = Report()
+    subjects = 0
+    for label, healed in resilience_rules.iter_healed_corpus():
+        resilience_rules.check_healed(healed, label, report)
+        row, col = healed.plan.stochasticity_error()
+        assert row < 1e-9 and col < 1e-9, (label, row, col)
+        assert set(healed.survivors).isdisjoint(healed.dead), label
+        subjects += 1
+    assert report.ok, report.summary() + "\n" + "\n".join(
+        str(f) for f in report.findings[:10])
+    assert subjects > 300  # 7 topologies x 13 sizes x 3-4 dead sets
+
+
+def test_heal_star_center_death_reconnects():
+    """Killing the star's center disconnects every survivor pair — the
+    healing must add the ring and still come out doubly stochastic."""
+    healed = healing.heal_topology(topology_util.StarGraph(8), dead=[0])
+    assert healed.reconnected
+    assert healed.survivors == tuple(range(1, 8))
+    row, col = healed.plan.stochasticity_error()
+    assert row < 1e-12 and col < 1e-12
+    _, gap = plan_rules.check_spectral_gap(healed.plan, "star-headless")
+    assert gap > 0
+
+
+def test_heal_down_to_one_survivor():
+    healed = healing.heal_topology(topology_util.RingGraph(4), dead=[0, 2, 3])
+    assert healed.survivors == (1,) and healed.size == 1
+    assert healed.plan.size == 1
+    W = healing.healed_weight_matrix(healed)
+    np.testing.assert_allclose(W, [[1.0]])
+
+
+def test_heal_rejects_bad_dead_sets():
+    topo = topology_util.RingGraph(4)
+    with pytest.raises(ValueError, match="no survivors"):
+        healing.heal_topology(topo, dead=[0, 1, 2, 3])
+    with pytest.raises(ValueError, match="not in topology"):
+        healing.heal_topology(topo, dead=[7])
+
+
+def test_heal_rank_maps_round_trip():
+    healed = healing.heal_topology(topology_util.ExponentialTwoGraph(8),
+                                   dead=[2, 5])
+    assert healed.to_global == (0, 1, 3, 4, 6, 7)
+    for g in healed.survivors:
+        assert healed.to_global[healed.to_local[g]] == g
+    # in-neighbor queries answer in GLOBAL ranks and never name the dead
+    for g in healed.survivors:
+        nbrs = healed.local_in_neighbors(g)
+        assert set(nbrs) <= set(healed.survivors)
+
+
+# ---------------------------------------------------------------------------
+# degraded combine rows
+# ---------------------------------------------------------------------------
+
+
+def test_degraded_update_weights_absorb_conserves_rows():
+    """The SPMD degraded-combine helper: dead in-neighbors are dropped and
+    their compiled weight is ABSORBED into self, so every row total is
+    bit-identical to the healthy plan's (convexity and push-sum mass
+    conservation survive the excision)."""
+    from bluefog_tpu.core.plan import compile_plan
+
+    plan = compile_plan(topology_util.ExponentialTwoGraph(8))
+    W = plan.mixing_matrix()
+    sw, nw = degraded_update_weights(plan, dead=[3, 6])
+    for d in range(8):
+        assert sw[d] + sum(nw[d].values()) == pytest.approx(
+            W[d].sum(), abs=1e-15)
+        if d not in (3, 6):
+            assert not {3, 6} & set(nw[d])
+
+
+def test_renormalize_weights_rescales_to_one():
+    sw, nw = degraded.renormalize_weights(0.25, {1: 0.25, 2: 0.25, 3: 0.25},
+                                          dead=[2])
+    assert sw + sum(nw.values()) == pytest.approx(1.0)
+    assert 2 not in nw and set(nw) == {1, 3}
+    # every neighbor dead: the rank gossips with itself
+    sw, nw = degraded.renormalize_weights(0.5, {1: 0.5}, dead=[1])
+    assert (sw, nw) == (1.0, {})
+
+
+def test_with_deadline_retries_then_raises():
+    calls = []
+
+    def always_late(budget):
+        calls.append(budget)
+        raise TimeoutError("nope")
+
+    healed = []
+    with pytest.raises(degraded.DeadlineExceeded, match="probe-op"):
+        degraded.with_deadline(always_late, "probe-op", deadline=0.2,
+                               retries=3, backoff=0.001,
+                               on_timeout=lambda: healed.append(1))
+    assert len(calls) == 3 and len(healed) == 3
+    # success path returns the value without retrying
+    assert degraded.with_deadline(lambda b: "ok", "probe-op",
+                                  deadline=0.2) == "ok"
+
+
+# ---------------------------------------------------------------------------
+# failure detector
+# ---------------------------------------------------------------------------
+
+
+class _FakeJob:
+    """Duck-typed transport: controllable per-rank liveness stamps."""
+
+    def __init__(self):
+        self.stamps = {}
+        self.beats = 0
+
+    def heartbeat(self):
+        self.beats += 1
+
+    def liveness(self, rank):
+        return self.stamps.get(rank, 0.0)
+
+
+def test_detector_declares_and_stays_dead():
+    job = _FakeJob()
+    det = FailureDetector(job, rank=0, nranks=3, timeout=0.1, interval=0.02)
+    now = time.monotonic()
+    job.stamps = {1: now, 2: now}
+    assert det.dead_ranks() == set()
+    job.stamps[2] = now - 10.0  # rank 2's stamp goes stale
+    time.sleep(0.12)
+    job.stamps[1] = time.monotonic()  # rank 1 kept heartbeating
+    assert det.dead_ranks() == {2}
+    # monotone: a fresh stamp does NOT resurrect a declared-dead rank
+    job.stamps[2] = time.monotonic()
+    assert det.dead_ranks() == {2}
+    det.declare_dead(1)
+    assert det.dead_ranks() == {1, 2}
+    det.stop()
+
+
+def test_detector_startup_grace_then_timeout():
+    job = _FakeJob()
+    det = FailureDetector(job, rank=0, nranks=2, timeout=0.15, interval=0.02)
+    # rank 1 never beat: alive during the startup grace...
+    assert det.dead_ranks() == set()
+    time.sleep(0.2)
+    # ...dead once the grace (measured from detector birth) expires
+    assert det.dead_ranks() == {1}
+    det.stop()
+
+
+def test_detector_unsupported_transport_degrades_to_alive():
+    det = FailureDetector(object(), rank=0, nranks=4, timeout=0.01)
+    assert not det.supported
+    time.sleep(0.03)
+    assert det.dead_ranks() == set()
+    det.stop()
+
+
+def test_detector_background_thread_beats():
+    job = _FakeJob()
+    with FailureDetector(job, rank=0, nranks=1, interval=0.01) as det:
+        time.sleep(0.08)
+        assert det.supported
+    assert job.beats >= 3
+
+
+# ---------------------------------------------------------------------------
+# dead-writer drain on the chunk-ring slot protocol
+# ---------------------------------------------------------------------------
+
+
+def test_chunk_ring_dead_writer_force_drain():
+    """A writer killed mid-deposit leaves a torn slot (odd wseq, odd chunk
+    seqlock): readers must refuse it, and force_drain must restore a
+    readable logical-zero slot without losing any COMMITTED deposit mass
+    (DEPOSIT_COMMITS_AFTER_PAYLOAD: the torn deposit committed nothing)."""
+    m = shm_native.ChunkRingMirror(nbytes=256, chunk=64)
+    first = bytes(range(64)) * 4
+    m.write(first, p=1.0)
+    data, p, version = m.read()
+    assert data == first and p == 1.0 and version == 1
+
+    chaos.corrupt_chunk(m, data=b"\xff" * 256, tear_at=2)
+    with pytest.raises(TimeoutError):
+        m.read(retries=8)  # torn writer never publishes
+    with pytest.raises(TimeoutError):
+        m.read_chunk(2, retries=8)
+
+    m.force_drain()
+    data, p, version = m.read()
+    assert data == b"\x00" * 256 and p == 0.0
+    assert version == 1  # the torn deposit committed zero mass
+
+    # the slot is fully live again after the drain
+    second = b"\xab" * 256
+    m.write(second, p=0.5)
+    data, p, version = m.read()
+    assert data == second and p == 0.5 and version == 2
+
+
+def test_chunk_ring_frozen_writer_can_also_resume():
+    """The drain is for DEAD writers; a merely-preempted writer resumes
+    and publishes the full deposit (no spurious drain needed)."""
+    m = shm_native.ChunkRingMirror(nbytes=128, chunk=64)
+    payload = b"\x11" * 128
+    m.begin_torn_write(payload, p=2.0, tear_at=1)
+    m.complete_write()
+    data, p, version = m.read()
+    assert data == payload and p == 2.0 and version == 1
+
+
+def test_window_force_drain_across_transports(tmp_path, monkeypatch):
+    """window.force_drain on both shm transports: a deposited slot reads
+    as logical zero afterwards and accepts fresh deposits."""
+    for fallback in ("0", "1"):
+        monkeypatch.setenv("BLUEFOG_SHM_FALLBACK", fallback)
+        if fallback == "1":
+            monkeypatch.setattr(shm_native, "_FALLBACK_DIR", str(tmp_path))
+        w = shm_native.make_window(f"fd{os.getpid()}_{fallback}", "x",
+                                   rank=0, nranks=2, maxd=2,
+                                   shape=(4,), dtype=np.float32)
+        drain = getattr(w, "force_drain", None)
+        if drain is None:
+            w.close(unlink=True)
+            pytest.skip("transport lacks force_drain")
+        w.write(0, 1, np.arange(4, dtype=np.float32), p=1.0)
+        drain(1, src=0)
+        a, p, _v = w.read(1)
+        np.testing.assert_allclose(a, 0.0)
+        assert p == 0.0
+        w.write(0, 1, np.full(4, 7.0, np.float32), p=0.25)
+        a, p, _v = w.read(1)
+        np.testing.assert_allclose(a, 7.0)
+        assert p == 0.25
+        w.close(unlink=True)
+
+
+# ---------------------------------------------------------------------------
+# tcp transport: bounded peer waits
+# ---------------------------------------------------------------------------
+
+
+def test_tcp_peer_timeout_names_the_rank(monkeypatch):
+    """A request to a peer that accepts but never replies must surface as
+    PeerTimeoutError naming the rank within BFTPU_PEER_TIMEOUT_S — the
+    settimeout(None) unbounded hang this PR removed."""
+    from bluefog_tpu.native import tcp_transport as tt
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    held = []
+    t = threading.Thread(
+        target=lambda: held.append(srv.accept()), daemon=True)
+    t.start()
+    monkeypatch.setenv("BFTPU_PEER_TIMEOUT_S", "0.3")
+    peers = tt._Peers({5: f"127.0.0.1:{port}"})
+    t0 = time.monotonic()
+    with pytest.raises(PeerTimeoutError, match="rank 5") as ei:
+        peers.request(5, tt._OP_BARRIER)
+    assert ei.value.rank == 5
+    assert time.monotonic() - t0 < 5.0
+    srv.close()
+
+
+def test_peer_timeout_env_knob(monkeypatch):
+    from bluefog_tpu.native.tcp_transport import peer_timeout_s
+
+    monkeypatch.delenv("BFTPU_PEER_TIMEOUT_S", raising=False)
+    assert peer_timeout_s() == 120.0
+    monkeypatch.setenv("BFTPU_PEER_TIMEOUT_S", "7.5")
+    assert peer_timeout_s() == 7.5
+    monkeypatch.setenv("BFTPU_PEER_TIMEOUT_S", "0")  # 0 disables the bound
+    assert peer_timeout_s() is None
+
+
+# ---------------------------------------------------------------------------
+# single-rank island runtime: timed waits and mutex deadlines
+# ---------------------------------------------------------------------------
+
+
+def test_island_timed_barrier_and_mutex_deadline(monkeypatch):
+    job = f"resil1_{os.getpid()}"
+    islands.init(0, 1, job)
+    try:
+        islands.barrier(timeout=5.0)  # single rank: completes immediately
+        # a wedged mutex (holder died mid-critical-section) must bound the
+        # wait: the job-level acquire is held by "someone else" here
+        islands._ctx().shm_job.mutex_acquire(0)
+        monkeypatch.setenv("BFTPU_OP_DEADLINE_S", "0.2")
+        t0 = time.monotonic()
+        with pytest.raises(degraded.DeadlineExceeded, match="win_mutex"):
+            with islands.win_mutex("w", ranks=[0], for_self=True):
+                pass
+        assert time.monotonic() - t0 < 5.0
+        islands._ctx().shm_job.mutex_release(0)
+        monkeypatch.delenv("BFTPU_OP_DEADLINE_S")
+        with islands.win_mutex("w", ranks=[0], for_self=True):
+            pass  # released: acquires fine
+    finally:
+        islands.shutdown(unlink=True)
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e: kill a rank mid-gossip, survivors heal and converge
+# ---------------------------------------------------------------------------
+
+
+def _worker_chaos_gossip(rank, size):
+    """np=4 exp2 gossip; the chaos schedule SIGKILLs one rank mid-stream.
+    Survivors: bounded barrier waits -> detect -> heal -> degraded
+    async gossip to consensus.  No unbounded wait anywhere."""
+    islands.set_topology(topology_util.ExponentialTwoGraph(size))
+    islands.win_create(np.full(3, float(rank * 10), np.float64), "cg")
+    islands.barrier()  # everyone created; last unbounded wait in the run
+    deadline = time.monotonic() + 120.0
+    while time.monotonic() < deadline:
+        chaos.checkpoint(rank, "gossip")  # the victim dies here
+        islands.win_put(islands.win_sync("cg"), "cg")
+        try:
+            islands.barrier(timeout=3.0)
+            islands.win_update("cg")
+            islands.barrier(timeout=3.0)
+        except TimeoutError:
+            break  # a sibling stopped arriving
+        if islands.dead_ranks():
+            break
+    while time.monotonic() < deadline and not islands.dead_ranks():
+        time.sleep(0.05)
+    dead = islands.dead_ranks()
+    assert dead, "victim death never detected"
+    healed = islands.heal()
+    row_err, col_err = healed.plan.stochasticity_error()
+    # degraded asynchronous gossip (no barriers: there is nobody to
+    # coordinate the dead rank's slot) converges to consensus
+    for _ in range(150):
+        islands.win_put(islands.win_sync("cg"), "cg")
+        islands.win_update("cg")
+        time.sleep(0.002)
+    out = islands.win_sync("cg").copy()
+    return (sorted(dead), healed.size, bool(healed.reconnected),
+            float(row_err), float(col_err), out)
+
+
+def test_chaos_kill_rank_mid_gossip_survivors_heal(monkeypatch):
+    """The acceptance e2e: np=4 island mode over exp2, one rank SIGKILLed
+    mid win_put stream; every survivor detects the death, heals to the
+    same doubly-stochastic 3-rank topology, and completes degraded gossip
+    to consensus without any wait blocking past its deadline."""
+    size, victim = 4, 1
+    monkeypatch.setenv("BFTPU_FAILURE_TIMEOUT_S", "1.0")
+    chaos.schedule_kill(os.environ, rank=victim, step=3)
+    try:
+        res = islands.spawn(_worker_chaos_gossip, size, timeout=300.0,
+                            allow_failures=True)
+    finally:
+        chaos.clear_schedule()
+    assert res[victim] is None, "the victim was supposed to die"
+    survivors = [r for r in range(size) if r != victim]
+    outs = []
+    for r in survivors:
+        assert res[r] is not None, f"survivor {r} produced no result"
+        dead, healed_size, _reconnected, row_err, col_err, out = res[r]
+        assert dead == [victim]
+        assert healed_size == size - 1
+        # the healed survivor W is doubly stochastic on every survivor
+        assert row_err < 1e-9 and col_err < 1e-9
+        outs.append(out)
+    flat = np.stack(outs)
+    # consensus: all survivor values agree far inside the initial spread
+    # (0/20/30), and stay inside the convex hull of the initial values
+    assert float(flat.max() - flat.min()) < 1.0, flat
+    assert flat.min() > -1e-9 and flat.max() < 30.0 + 1e-9
+
+
+# ---------------------------------------------------------------------------
+# launcher: grace period + first-failing exit code
+# ---------------------------------------------------------------------------
+
+
+def test_launcher_grace_lets_survivors_finish(tmp_path):
+    """One rank exits nonzero; with the grace period the surviving rank
+    gets to finish its work (and the FIRST failing code propagates)."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = tmp_path / "survivor.txt"
+    script = (
+        "import os, time\n"
+        "from bluefog_tpu import islands\n"
+        "islands.init()\n"
+        "if islands.rank() == 1:\n"
+        "    raise SystemExit(7)\n"
+        "time.sleep(1.5)\n"
+        f"open({str(out)!r}, 'w').write('survived')\n"
+        "islands.shutdown(unlink=True)\n"
+    )
+    env = dict(os.environ, PYTHONPATH=repo, BFTPU_LAUNCH_GRACE_S="20")
+    proc = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.run.launcher", "--islands", "2",
+         "--job", f"grace{os.getpid()}", "--", sys.executable, "-c", script],
+        env=env, capture_output=True, text=True, timeout=120, cwd=repo,
+    )
+    assert proc.returncode == 7, (proc.returncode, proc.stderr[-800:])
+    assert out.read_text() == "survived", proc.stderr[-800:]
+
+
+def test_launcher_zero_grace_restores_immediate_teardown(tmp_path):
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = tmp_path / "survivor.txt"
+    script = (
+        "import os, time\n"
+        "from bluefog_tpu import islands\n"
+        "islands.init()\n"
+        "if islands.rank() == 1:\n"
+        "    raise SystemExit(9)\n"
+        "time.sleep(30)\n"
+        f"open({str(out)!r}, 'w').write('survived')\n"
+    )
+    env = dict(os.environ, PYTHONPATH=repo, BFTPU_LAUNCH_GRACE_S="0")
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.run.launcher", "--islands", "2",
+         "--job", f"grace0{os.getpid()}", "--", sys.executable, "-c", script],
+        env=env, capture_output=True, text=True, timeout=120, cwd=repo,
+    )
+    assert proc.returncode == 9, (proc.returncode, proc.stderr[-800:])
+    assert time.monotonic() - t0 < 60
+    assert not out.exists()  # the sleeper was torn down, not waited for
