@@ -451,6 +451,17 @@ def main():
             print(json.dumps(bw_proto), file=sys.stderr)
         except Exception as e:  # noqa: BLE001
             print(f"island protocol phase failed: {e!r}", file=sys.stderr)
+    tel = None
+    if time.perf_counter() - t_start < budget_s:
+        try:
+            # telemetry overhead gate (docs/OBSERVABILITY.md): the same
+            # 2-process shm win_put loop with BFTPU_TELEMETRY on vs off;
+            # the registry's enabled-guard contract is < 2%
+            from gossip_bandwidth import measure_telemetry_overhead
+            tel = measure_telemetry_overhead(nprocs=2)
+            print(json.dumps(tel), file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"telemetry overhead phase failed: {e!r}", file=sys.stderr)
     rec = None
     if time.perf_counter() - t_start < budget_s:
         try:
@@ -517,6 +528,9 @@ def main():
     if bw_proto is not None:
         headline["island_protocol_ceiling_gbs"] = bw_proto["value"]
         headline["island_protocol_vs_raw_memcpy"] = bw_proto["vs_raw_memcpy"]
+    if tel is not None:
+        headline["telemetry_overhead_pct"] = tel["value"]
+        headline["telemetry_overhead_metric"] = tel["metric"]
     if rec is not None:
         headline["recovery_ms"] = rec["value"]
         headline["recovery_metric"] = rec["metric"]

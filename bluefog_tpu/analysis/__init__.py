@@ -18,6 +18,10 @@ consumers (CLI, pytest, CI):
   doubly stochastic and mixing with the dead fully excised, degraded
   combine rows conserve mass, and the force-drain of a dead writer's
   slot loses no committed deposit at any death point;
+- **telemetry** (:mod:`.telemetry_rules`) — snapshot schema, counter
+  monotonicity, the mailbox-ledger conservation identity
+  (deposits == collected + drained + pending on a quiescent job), and
+  the env-var lint (every BFTPU_*/BLUEFOG_* knob documented);
 - the **fixture corpus** (:mod:`.fixtures`) — seeded bugs proving every
   rule fires.
 
@@ -45,6 +49,7 @@ from bluefog_tpu.analysis import (  # noqa: F401
     plan_rules,
     resilience_rules,
     seqlock_model,
+    telemetry_rules,
 )
 
 __all__ = [

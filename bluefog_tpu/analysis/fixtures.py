@@ -31,6 +31,7 @@ from bluefog_tpu.analysis import (
     plan_rules,
     resilience_rules,
     seqlock_model,
+    telemetry_rules,
 )
 from bluefog_tpu.analysis.engine import Finding
 
@@ -180,6 +181,68 @@ def _model_fixture(model) -> List[Finding]:
     return seqlock_model.check_model(model).findings
 
 
+# ---------------------------------------------------------------------------
+# telemetry fixtures: mutate real in-memory Registry snapshots
+# ---------------------------------------------------------------------------
+
+
+def _telemetry_counter_regression() -> List[Finding]:
+    """A snapshot sequence where a counter value goes BACKWARD (the bug a
+    raced read-modify-write or an accidental reset would produce)."""
+    from bluefog_tpu.telemetry.registry import Registry as TReg
+
+    reg = TReg(out_dir=None, rank=0, job="fixture")
+    reg.counter("tcp.round_trips").add(10)
+    first = reg.snapshot()
+    second = reg.snapshot()
+    for c in second["counters"]:
+        if c["name"] == "tcp.round_trips":
+            c["value"] = 3.0  # regressed
+    return telemetry_rules.check_counters_monotone(
+        [first, second], label="fixture[regressed-counter]")
+
+
+def _telemetry_snapshot_bad_schema() -> List[Finding]:
+    """A real snapshot with its schema tag clobbered and a histogram
+    counts array truncated (missing the overflow bucket)."""
+    from bluefog_tpu.telemetry.registry import Registry as TReg
+
+    reg = TReg(out_dir=None, rank=0, job="fixture")
+    reg.histogram("win.op_s", op="win_put").observe(1e-4)
+    snap = reg.snapshot()
+    snap["schema"] = "bftpu-telemetry-snapshot/999"
+    snap["histograms"][0]["counts"] = snap["histograms"][0]["counts"][:-1]
+    return telemetry_rules.check_snapshot_schema(
+        snap, label="fixture[bad-schema]")
+
+
+def _telemetry_conservation_broken() -> List[Finding]:
+    """A 2-rank corpus where one deposit was never retired into any sink
+    — the lost-mass signature the ledger identity exists to catch."""
+    from bluefog_tpu.telemetry.registry import (
+        LEDGER_COLLECTED, LEDGER_DEPOSITS, Registry as TReg)
+
+    snaps = []
+    for r in range(2):
+        reg = TReg(out_dir=None, rank=r, job="fixture")
+        reg.counter(LEDGER_DEPOSITS).add(4)
+        reg.counter(LEDGER_COLLECTED).add(3 if r else 4)  # rank 1 lost one
+        snaps.append(reg.snapshot())
+    return telemetry_rules.check_conservation(
+        snaps, label="fixture[lost-deposit]")
+
+
+def _envlint_undocumented_var() -> List[Finding]:
+    """A referenced env knob that appears in no doc — the lint must name
+    the var and the files using it."""
+    # name assembled at runtime so the env lint's source scan (which
+    # reads THIS file) never sees the seeded knob as a real reference
+    var = "BFTPU_" + "SEEDED_UNDOCUMENTED_KNOB"
+    return telemetry_rules.check_env_documented(
+        {var: ["bluefog_tpu/fake.py"]},
+        documented=set(), label="fixture[undocumented-var]")
+
+
 FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
     # plan family
     "plan-duplicate-destination": _plan_duplicate_destination,
@@ -220,6 +283,11 @@ FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
     "dead-writer-early-commit": lambda: _model_fixture(
         seqlock_model.dead_writer_drain_model(deposits=2,
                                               commits_after_payload=False)),
+    # telemetry family: broken snapshots, regressed counters, lost mass
+    "telemetry-counter-regression": _telemetry_counter_regression,
+    "telemetry-snapshot-bad-schema": _telemetry_snapshot_bad_schema,
+    "telemetry-conservation-broken": _telemetry_conservation_broken,
+    "envlint-undocumented-var": _envlint_undocumented_var,
     # epoch family: ill-ordered window traces
     "epoch-use-after-free": lambda: epoch_rules.check_trace(
         [("win_create", "w"), ("win_put", "w"), ("win_free", "w"),

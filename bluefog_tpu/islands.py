@@ -37,7 +37,6 @@ from __future__ import annotations
 import contextlib
 import itertools
 import os
-import sys
 import time
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
@@ -49,6 +48,7 @@ from bluefog_tpu.native import shm_native
 from bluefog_tpu.resilience import degraded as _degraded
 from bluefog_tpu.resilience import healing as _healing
 from bluefog_tpu.resilience.detector import FailureDetector
+from bluefog_tpu.telemetry import registry as _telemetry
 from bluefog_tpu.timeline import timeline_context
 
 __all__ = [
@@ -107,6 +107,7 @@ class _IslandWindow:
         self.self_tensor = np.array(tensor, copy=True)
         self.p_self = 1.0
         self._scratch: Optional[np.ndarray] = None  # win_update staging
+        self._tel_cache = None  # (registry, {key: metric handle}) memo
         self.shm = shm_native.make_window(
             ctx.job, name, ctx.rank, ctx.size, maxd,
             tensor.shape, tensor.dtype,
@@ -121,6 +122,15 @@ class _IslandWindow:
         if not zero_init:
             for k, s in enumerate(self.in_neighbors):
                 self.shm.write(ctx.rank, k, tensor, p=1.0, writer=s)
+        # mass-ledger bookkeeping (telemetry conservation invariant): slot
+        # ``version`` is a monotone deposit count; ``_ledger_seen[slot]`` is
+        # the last version this reader retired (collected/drained/pending).
+        # The seed writes above are pre-retired — they are not deposits any
+        # writer counted.
+        self._ledger_seen: Dict[int, int] = {
+            k: (0 if zero_init else 1)
+            for k in range(len(self.in_neighbors))
+        }
         ctx.shm_job.barrier()
 
 
@@ -181,6 +191,13 @@ def init(rank_: Optional[int] = None, size_: Optional[int] = None,
     j = os.environ.get("BLUEFOG_ISLAND_JOB", "default") if job is None else job
     if not (0 <= r < n):
         raise ValueError(f"rank {r} out of range for size {n}")
+    reg = _telemetry.get_registry()
+    if reg.enabled:
+        # spawn() passes rank/size/job as arguments, not env — point the
+        # registry at the real identity so per-rank snapshot files do not
+        # collide on the env-derived default (rank 0)
+        reg.rank, reg.job = r, j
+        reg.journal("island_init", size=n)
     _context = _IslandContext(r, n, j)
     _context.shm_job.barrier()
 
@@ -199,7 +216,13 @@ def shutdown(unlink: bool = False) -> None:
         return
     ctx = _context
     ctx.detector.stop()
+    reg = _telemetry.get_registry()
     for w in ctx.windows.values():
+        if reg.enabled:
+            # windows still live at shutdown: whatever mass their slots
+            # hold retires as "pending" (callers barrier before shutdown,
+            # so on clean runs the deposits are all committed by now)
+            _ledger_probe_pending(reg, w, ctx.rank)
         w.shm.close(unlink=False)
     names = list(ctx.created_names)
     ctx.windows.clear()
@@ -299,6 +322,8 @@ def heal(dead=None):
     coordinate with — that is the failure mode being handled).
     """
     ctx = _ctx()
+    reg = _telemetry.get_registry()
+    t0 = time.perf_counter_ns() if reg.enabled else 0
     dead = set(ctx.detector.dead_ranks() if dead is None else dead)
     for r in dead:
         ctx.detector.declare_dead(r)
@@ -317,8 +342,18 @@ def heal(dead=None):
             continue
         for s in win.in_neighbors:
             if s in new:
-                drain(win.slot_of[ctx.rank][s], src=s)
+                slot = win.slot_of[ctx.rank][s]
+                if reg.enabled:
+                    _ledger_retire_probe(
+                        reg, win, slot, s, _telemetry.LEDGER_DRAINED)
+                drain(slot, src=s)
     ctx.healed = _healing.heal_topology(ctx.topology, sorted(ctx.dead))
+    if reg.enabled and new:
+        dt = (time.perf_counter_ns() - t0) / 1e9
+        reg.counter("resilience.heals").inc()
+        reg.histogram("resilience.heal_s").observe(dt)
+        reg.journal("heal", new_dead=sorted(new), dead=sorted(ctx.dead),
+                    duration_s=dt)
     return ctx.healed
 
 
@@ -470,11 +505,20 @@ def win_free(name: Optional[str] = None) -> bool:
     ctx = _ctx()
     names = [name] if name is not None else sorted(ctx.windows)
     ok = True
+    reg = _telemetry.get_registry()
     for n in names:
         w = ctx.windows.pop(n, None)
         if w is None:
             ok = False
             continue
+        if reg.enabled:
+            # ledger: account mass left in the slots as "pending" — but
+            # only after every rank has entered this collective free (a
+            # slower peer may still be mid-deposit), so barrier first.
+            # BFTPU_TELEMETRY must be uniform across ranks (the launcher
+            # forwards it), keeping the barrier schedule identical.
+            ctx.shm_job.barrier()
+            _ledger_probe_pending(reg, w, ctx.rank)
         w.shm.close(unlink=False)
         ctx.shm_job.barrier()  # all mappings closed
         # transport-aware designated unlink (plain shm: global rank 0;
@@ -495,6 +539,8 @@ def win_put(tensor, name: str, dst_weights: WeightDict = None) -> bool:
     with timeline_context("island_win_put"):
         ctx = _ctx()
         win = _win(name)
+        reg = _telemetry.get_registry()
+        t0 = time.perf_counter_ns() if reg.enabled else 0
         t = _to_host(_island_pack(name, tensor)).astype(win.shm.dtype, copy=False)
         # alias, don't copy: upstream the window aliases the user tensor's
         # memory, and the shm exposure below is already a stable snapshot
@@ -527,6 +573,11 @@ def win_put(tensor, name: str, dst_weights: WeightDict = None) -> bool:
                               p=win.p_self * wgt, accumulate=False)
         if not exposed:
             win.shm.expose(t, win.p_self)
+        if reg.enabled:
+            for d in targets:
+                _edge_deposit(reg, win, "win_put", ctx.rank, d, t.nbytes)
+            _op_hist(reg, win, "win_put").observe(
+                (time.perf_counter_ns() - t0) / 1e9)
         _note_op("win_put", name)
     return True
 
@@ -539,14 +590,94 @@ def _scaled_transport(win: _IslandWindow) -> bool:
 
 
 def _note_op(op: str, name: str) -> None:
-    """Record an island window op into the shared win-op log so
-    ``windows.record_win_ops()`` traces (and the verifier's epoch linter)
-    cover island-mode programs too.  Looked up via sys.modules: if
-    :mod:`bluefog_tpu.windows` was never imported, no recorder can be
-    active, and importing it here would pull jax into every island worker."""
-    _windows = sys.modules.get("bluefog_tpu.windows")
-    if _windows is not None:
-        _windows.note_win_op(op, name)
+    """Record an island window op through the single telemetry event path
+    (``telemetry.note_op``): bumps the ``win_ops.total`` counter and fans
+    out to listeners — ``windows.record_win_ops()`` traces (and the
+    verifier's epoch linter) subscribe there, so island-mode programs are
+    covered without a parallel bookkeeping path (and without importing
+    :mod:`bluefog_tpu.windows`, which would pull jax into every island
+    worker)."""
+    _telemetry.note_op(op, name)
+
+
+# ---------------------------------------------------------------------------
+# telemetry helpers: per-edge traffic counters + the mailbox mass ledger.
+# Every helper is called behind a ``reg.enabled`` guard, so the disabled
+# path costs one attribute load and a falsy branch per op.
+# ---------------------------------------------------------------------------
+
+
+def _tel_table(reg, win: _IslandWindow) -> dict:
+    """The window's memoized metric-handle table for ``reg``.  A labeled
+    handle lookup (``reg.counter(name, **labels)``) costs ~2µs in label-key
+    construction; an op touches several handles, which is visible next to a
+    ~ms mailbox deposit.  Handles are stable objects, so the hot paths cache
+    them per window, invalidating if telemetry is reset to a new registry."""
+    cache = win._tel_cache
+    if cache is None or cache[0] is not reg:
+        win._tel_cache = cache = (reg, {})
+    return cache[1]
+
+
+def _edge_deposit(reg, win: _IslandWindow, op: str, src: int, dst: int,
+                  nbytes: int) -> None:
+    """Writer-side accounting for ONE mailbox deposit on edge src->dst."""
+    tbl = _tel_table(reg, win)
+    h = tbl.get(("e", op, src, dst))
+    if h is None:
+        h = tbl[("e", op, src, dst)] = (
+            reg.counter("win.edge_ops", op=op, src=src, dst=dst),
+            reg.counter("win.edge_bytes", op=op, src=src, dst=dst),
+            reg.counter(_telemetry.LEDGER_DEPOSITS),
+        )
+    h[0].inc()
+    h[1].add(int(nbytes))
+    h[2].inc()
+
+
+def _op_hist(reg, win: _IslandWindow, op: str):
+    """Memoized ``win.op_s`` latency histogram handle for ``op``."""
+    tbl = _tel_table(reg, win)
+    h = tbl.get(("h", op))
+    if h is None:
+        h = tbl[("h", op)] = reg.histogram("win.op_s", op=op)
+    return h
+
+
+def _ledger_retire(reg, win: _IslandWindow, slot: int, ver: int,
+                   what: str) -> None:
+    """Retire slot versions up to ``ver`` into ledger counter ``what``.
+    Versions are monotone deposit counts, so retirement telescopes: the
+    total ever retired equals the last version probed, regardless of how
+    individual deposits were classified under concurrent writers."""
+    seen = win._ledger_seen.get(slot, 0)
+    if ver > seen:
+        tbl = _tel_table(reg, win)
+        c = tbl.get(("lc", what))
+        if c is None:
+            c = tbl[("lc", what)] = reg.counter(what)
+        c.add(int(ver - seen))
+        win._ledger_seen[slot] = int(ver)
+
+
+def _ledger_retire_probe(reg, win: _IslandWindow, slot: int, src: int,
+                         what: str) -> None:
+    rv = getattr(win.shm, "read_version", None)
+    if rv is None:
+        return
+    try:
+        ver = rv(slot, src=src)
+    except Exception:  # noqa: BLE001 - accounting must never break the op
+        return
+    _ledger_retire(reg, win, slot, int(ver), what)
+
+
+def _ledger_probe_pending(reg, win: _IslandWindow, rank_: int) -> None:
+    """Retire whatever each slot still holds as "pending" (window free /
+    job shutdown: mass deposited but never combined)."""
+    for s in win.in_neighbors:
+        _ledger_retire_probe(reg, win, win.slot_of[rank_][s], s,
+                             _telemetry.LEDGER_PENDING)
 
 
 def win_accumulate(tensor, name: str, dst_weights: WeightDict = None) -> bool:
@@ -557,6 +688,8 @@ def win_accumulate(tensor, name: str, dst_weights: WeightDict = None) -> bool:
     with timeline_context("island_win_accumulate"):
         ctx = _ctx()
         win = _win(name)
+        reg = _telemetry.get_registry()
+        t0 = time.perf_counter_ns() if reg.enabled else 0
         t = _to_host(_island_pack(name, tensor)).astype(win.shm.dtype, copy=False)
         targets = _check_dst(win, dst_weights)
         if ctx.dead:
@@ -572,6 +705,11 @@ def win_accumulate(tensor, name: str, dst_weights: WeightDict = None) -> bool:
                 payload = t if wgt == 1.0 else t * wgt
                 win.shm.write(d, win.slot_of[d][ctx.rank], payload,
                               p=win.p_self * wgt, accumulate=True)
+        if reg.enabled:
+            for d in targets:
+                _edge_deposit(reg, win, "win_accumulate", ctx.rank, d, t.nbytes)
+            _op_hist(reg, win, "win_accumulate").observe(
+                (time.perf_counter_ns() - t0) / 1e9)
         _note_op("win_accumulate", name)
     return True
 
@@ -583,6 +721,8 @@ def win_get(name: str, src_weights: WeightDict = None) -> bool:
     with timeline_context("island_win_get"):
         ctx = _ctx()
         win = _win(name)
+        reg = _telemetry.get_registry()
+        t0 = time.perf_counter_ns() if reg.enabled else 0
         if src_weights is not None:
             unknown = set(src_weights) - set(win.in_neighbors)
             if unknown:
@@ -606,6 +746,13 @@ def win_get(name: str, src_weights: WeightDict = None) -> bool:
             else:
                 win.shm.write(ctx.rank, win.slot_of[ctx.rank][s], a * wgt,
                               p=p * wgt, accumulate=False, writer=s)
+            if reg.enabled:
+                # the pull deposits into MY slot on edge s->me; this rank
+                # performed the write, so this rank counts the deposit
+                _edge_deposit(reg, win, "win_get", s, ctx.rank, a.nbytes)
+        if reg.enabled:
+            _op_hist(reg, win, "win_get").observe(
+                (time.perf_counter_ns() - t0) / 1e9)
         _note_op("win_get", name)
     return True
 
@@ -630,6 +777,9 @@ def _resolve_update_weights(win: _IslandWindow, self_weight, neighbor_weights):
             dropped = sum(w for s, w in nw.items() if s in dead)
             nw = {s: w for s, w in nw.items() if s not in dead}
             sw += dropped
+            reg = _telemetry.get_registry()
+            if reg.enabled and dropped:
+                reg.counter("resilience.weight_absorbed").add(dropped)
     else:
         dead = _ctx().dead
         live = [s for s in nbrs if s not in dead] if dead else nbrs
@@ -653,6 +803,8 @@ def win_update(
     with timeline_context("island_win_update"):
         ctx = _ctx()
         win = _win(name)
+        reg = _telemetry.get_registry()
+        t0 = time.perf_counter_ns() if reg.enabled else 0
         sw, nw = _resolve_update_weights(win, self_weight, neighbor_weights)
         # after healing, dead in-neighbors are absent from nw: their slots
         # were force-drained and must not be combined (or even locked)
@@ -698,6 +850,16 @@ def win_update(
                 win.self_tensor = out_buf
             if ctx.associated_p:
                 win.p_self = float(p_acc)
+            if reg.enabled:
+                if reset:
+                    # the fused sweep drained the slots; the post-drain
+                    # version probe retires exactly what it collected
+                    for s in nbrs:
+                        _ledger_retire_probe(
+                            reg, win, win.slot_of[ctx.rank][s], s,
+                            _telemetry.LEDGER_COLLECTED)
+                _op_hist(reg, win, "win_update").observe(
+                    (time.perf_counter_ns() - t0) / 1e9)
             _note_op("win_update", name)
             out = win.self_tensor
             out = np.array(out, copy=True) if clone else out
@@ -712,8 +874,11 @@ def win_update(
             # payload is never materialized on the Python side, and
             # collect (reset) happens in the same critical section.
             for s in nbrs:
-                p, _ = combine(win.slot_of[ctx.rank][s], acc, nw[s],
-                               collect=reset, src=s)
+                slot = win.slot_of[ctx.rank][s]
+                p, ver = combine(slot, acc, nw[s], collect=reset, src=s)
+                if reset and reg.enabled:
+                    _ledger_retire(reg, win, slot, int(ver),
+                                   _telemetry.LEDGER_COLLECTED)
                 p_acc = p_acc + nw[s] * p
         else:
             # preallocated-scratch combine for the other transports: the
@@ -728,9 +893,11 @@ def win_update(
                 win._scratch = np.empty_like(acc)
             scratch = win._scratch
             for s in nbrs:
-                a, p, _ = win.shm.read(
-                    win.slot_of[ctx.rank][s], collect=reset, src=s
-                )
+                slot = win.slot_of[ctx.rank][s]
+                a, p, ver = win.shm.read(slot, collect=reset, src=s)
+                if reset and reg.enabled:
+                    _ledger_retire(reg, win, slot, int(ver),
+                                   _telemetry.LEDGER_COLLECTED)
                 np.multiply(a, nw[s], out=scratch, casting="unsafe")
                 np.add(acc, scratch, out=acc)
                 p_acc = p_acc + nw[s] * p
@@ -738,6 +905,9 @@ def win_update(
         if ctx.associated_p:
             win.p_self = float(p_acc)
         win.shm.expose(win.self_tensor, win.p_self)
+        if reg.enabled:
+            _op_hist(reg, win, "win_update").observe(
+                (time.perf_counter_ns() - t0) / 1e9)
         _note_op("win_update", name)
         out = win.self_tensor
         out = np.array(out, copy=True) if clone else out
@@ -1091,8 +1261,14 @@ class DistributedWinPutOptimizer:
         updates, state = self.base.update(grads, state, params)
         params = optax.apply_updates(params, updates)
         self._step_count += 1
+        reg = _telemetry.get_registry()
+        if reg.enabled:
+            reg.counter("optim.steps", optimizer="island_winput").inc()
         if self._step_count % self.k != 0:
             return params, state
+        if reg.enabled:
+            reg.counter("optim.gossip_rounds",
+                        optimizer="island_winput").inc()
         flat, treedef = jax.tree_util.tree_flatten(params)
         if self.overlap:
             if self._executor is None:

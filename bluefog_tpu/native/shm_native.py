@@ -33,8 +33,41 @@ from typing import Optional, Tuple
 import numpy as np
 
 from bluefog_tpu.native import get_lib
+from bluefog_tpu.telemetry import registry as _telemetry
 
 _DTYPE_CODES = {np.dtype(np.float32): 1, np.dtype(np.float64): 2}
+
+
+def _timed_mutex_acquire(acquire, rank: int, timeout: Optional[float]):
+    """Run a transport's raw mutex acquire under telemetry timing: total
+    wall nanoseconds spent waiting (``shm.mutex_wait_ns``), acquire count,
+    and timeout count — the contention signals docs/OBSERVABILITY.md
+    points at when win_mutex latency climbs."""
+    reg = _telemetry.get_registry()
+    if not reg.enabled:
+        return acquire(rank, timeout)
+    t0 = time.perf_counter_ns()
+    try:
+        return acquire(rank, timeout)
+    except TimeoutError:
+        reg.counter("shm.mutex_timeouts").inc()
+        raise
+    finally:
+        reg.counter("shm.mutex_wait_ns").add(
+            time.perf_counter_ns() - t0)
+        reg.counter("shm.mutex_acquires").inc()
+
+
+def _deposit_counters(obj, reg):
+    """Memoized (deposits, chunk_commits) counter pair for a window object.
+    Handle lookup costs ~1.5µs each; deposits ride every win op, so the
+    write paths cache the live handles on the window, invalidating when
+    telemetry is reset to a different registry."""
+    cache = getattr(obj, "_tel_cache", None)
+    if cache is None or cache[0] is not reg:
+        obj._tel_cache = cache = (
+            reg, reg.counter("shm.deposits"), reg.counter("shm.chunk_commits"))
+    return cache
 
 # ---------------------------------------------------------------------------
 # protocol specification (model-checked)
@@ -212,6 +245,10 @@ class NativeShmJob:
 
     def mutex_acquire(self, rank: int,
                       timeout: Optional[float] = None) -> None:
+        _timed_mutex_acquire(self._mutex_acquire_raw, rank, timeout)
+
+    def _mutex_acquire_raw(self, rank: int,
+                           timeout: Optional[float]) -> None:
         if timeout is None:
             self._lib.bf_shm_job_mutex_acquire(self._h, int(rank))
             return
@@ -299,6 +336,11 @@ class NativeShmWindow:
             a.ctypes.data_as(ctypes.c_void_p), float(p),
             1 if accumulate else 0, float(scale),
         )
+        reg = _telemetry.get_registry()
+        if reg.enabled:
+            _, dep, com = _deposit_counters(self, reg)
+            dep.inc()
+            com.add(self.nchunks)
 
     def read(self, slot: int, collect: bool = False, src=None, out=None):
         del src
@@ -315,6 +357,10 @@ class NativeShmWindow:
             self._h, int(slot), out.ctypes.data_as(ctypes.c_void_p),
             ctypes.byref(p), 1 if collect else 0,
         )
+        if collect:
+            reg = _telemetry.get_registry()
+            if reg.enabled:
+                reg.counter("shm.marker_drains").inc()
         return out, p.value, int(version)
 
     def combine(self, slot: int, acc: np.ndarray, weight: float = 1.0,
@@ -337,6 +383,10 @@ class NativeShmWindow:
             self._h, int(slot), acc.ctypes.data_as(ctypes.c_void_p),
             float(weight), 1 if collect else 0, ctypes.byref(p),
         )
+        if collect:
+            reg = _telemetry.get_registry()
+            if reg.enabled:
+                reg.counter("shm.marker_drains").inc()
         return p.value, int(version)
 
     def put_dual(self, dst: int, slot: int, array, p: float = 1.0,
@@ -359,6 +409,12 @@ class NativeShmWindow:
             a.ctypes.data_as(ctypes.c_void_p), float(p),
             1 if accumulate else 0, float(scale), float(expose_p),
         )
+        reg = _telemetry.get_registry()
+        if reg.enabled:
+            _, dep, com = _deposit_counters(self, reg)
+            dep.inc()
+            # both legs of the fused pass commit chunk-by-chunk
+            com.add(2 * self.nchunks)
 
     def update_fused(self, slots, weights, self_data: np.ndarray,
                      self_weight: float, self_p: float,
@@ -394,12 +450,17 @@ class NativeShmWindow:
         c_w = (ctypes.c_double * n)(*[float(w) for w in weights])
         out_ptr = (None if out is None
                    else out.ctypes.data_as(ctypes.c_void_p))
-        return float(self._lib.bf_shm_win_update_fused(
+        p_acc = float(self._lib.bf_shm_win_update_fused(
             self._h, n, c_slots, c_w,
             self_data.ctypes.data_as(ctypes.c_void_p), float(self_weight),
             float(self_p), out_ptr,
             1 if collect else 0, int(expose),
         ))
+        if collect and n:
+            reg = _telemetry.get_registry()
+            if reg.enabled:
+                reg.counter("shm.marker_drains").add(n)
+        return p_acc
 
     def exposed_view(self) -> np.ndarray:
         """A numpy view of my exposed payload, backed by an INDEPENDENT
@@ -463,6 +524,9 @@ class NativeShmWindow:
         the slot's writer dead — see DEAD_WRITER_DRAIN_STEPS."""
         del src
         self._lib.bf_shm_win_force_drain(self._h, int(slot))
+        reg = _telemetry.get_registry()
+        if reg.enabled:
+            reg.counter("shm.force_drains").inc()
 
     def expose(self, array, p: float = 1.0) -> None:
         a = _as_contiguous(array, self.dtype)
@@ -620,7 +684,7 @@ class ChunkRingMirror:
         """Whole-slot bracketed read: retry while ``wseq`` is odd or moves
         across the copy.  Raises TimeoutError once the retry budget is
         exhausted (a frozen torn writer never publishes)."""
-        for _ in range(retries):
+        for attempt in range(retries):
             before = self.wseq
             if before & 1:
                 continue
@@ -630,18 +694,26 @@ class ChunkRingMirror:
             if self.wseq == before:
                 if empty:
                     out[:] = 0
+                if attempt:
+                    reg = _telemetry.get_registry()
+                    if reg.enabled:
+                        reg.counter("shm.seqlock_retries").add(attempt)
                 return bytes(out), p, self.version
         raise TimeoutError("reader retry budget exhausted (torn writer)")
 
     def read_chunk(self, c: int, retries: int = 64) -> bytes:
         """Per-chunk bracketed read (the pipelined consumer's unit)."""
         sl = self._chunk_slice(c)
-        for _ in range(retries):
+        for attempt in range(retries):
             before = int(self.chunk_seq[c])
             if before & 1:
                 continue
             out = bytes(self.payload[sl])
             if int(self.chunk_seq[c]) == before:
+                if attempt:
+                    reg = _telemetry.get_registry()
+                    if reg.enabled:
+                        reg.counter("shm.seqlock_retries").add(attempt)
                 return out
         raise TimeoutError(
             f"chunk {c} retry budget exhausted (torn writer)")
@@ -749,6 +821,10 @@ class FallbackShmJob:
 
     def mutex_acquire(self, rank: int,
                       timeout: Optional[float] = None) -> None:
+        _timed_mutex_acquire(self._mutex_acquire_raw, rank, timeout)
+
+    def _mutex_acquire_raw(self, rank: int,
+                           timeout: Optional[float]) -> None:
         if timeout is None:
             self._seg.lock(16 + rank, 1)
             return
@@ -858,6 +934,11 @@ class FallbackShmWindow:
             struct.pack_into("<Qd", mm, off, version + 1, p)
         finally:
             self._unlock(idx)
+        reg = _telemetry.get_registry()
+        if reg.enabled:
+            _, dep, com = _deposit_counters(self, reg)
+            dep.inc()
+            com.inc()  # one whole-slot commit
 
     def read(self, slot: int, collect: bool = False, src=None, out=None):
         del src
@@ -873,6 +954,10 @@ class FallbackShmWindow:
                 struct.pack_into("<Qd", mm, off, version, 0.0)
         finally:
             self._unlock(idx)
+        if collect:
+            reg = _telemetry.get_registry()
+            if reg.enabled:
+                reg.counter("shm.marker_drains").inc()
         if out is not None:
             np.copyto(out, a)
             a = out
@@ -905,6 +990,10 @@ class FallbackShmWindow:
                 struct.pack_into("<Qd", mm, off, version, 0.0)
         finally:
             self._unlock(idx)
+        if collect:
+            reg = _telemetry.get_registry()
+            if reg.enabled:
+                reg.counter("shm.marker_drains").inc()
         return p, version
 
     def put_dual(self, dst: int, slot: int, array, p: float = 1.0,
@@ -973,6 +1062,9 @@ class FallbackShmWindow:
         """Dead-writer recovery.  lockf ranges die with their holder, so
         a dead writer cannot leave this slot locked — reset suffices."""
         self.reset(slot, src=src)
+        reg = _telemetry.get_registry()
+        if reg.enabled:
+            reg.counter("shm.force_drains").inc()
 
     def unlink_segments(self) -> None:
         if self.rank == 0:

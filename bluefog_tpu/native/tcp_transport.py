@@ -40,6 +40,7 @@ import numpy as np
 
 from bluefog_tpu.common.logging_util import logger
 from bluefog_tpu.resilience.detector import PeerTimeoutError
+from bluefog_tpu.telemetry import registry as _telemetry
 
 # ops
 _OP_WRITE = 1          # deposit into (my) mail slot: mode 0 put, 1 accumulate
@@ -52,6 +53,15 @@ _OP_PING = 7
 _OP_BARRIER_T = 8      # rank-0 only: timed barrier, timeout rides in p
 _OP_HEARTBEAT = 9      # rank-0 only: renew rank `slot`'s lease
 _OP_LIVENESS = 10      # rank-0 only: age of rank `slot`'s lease (in p)
+
+#: human-readable op names: PeerTimeoutError context + telemetry labels
+_OP_NAMES = {
+    _OP_WRITE: "write", _OP_READ_EXPOSED: "read_exposed",
+    _OP_MUTEX_ACQ: "mutex_acquire", _OP_MUTEX_REL: "mutex_release",
+    _OP_BARRIER: "barrier", _OP_REGISTER: "register", _OP_PING: "ping",
+    _OP_BARRIER_T: "barrier_timed", _OP_HEARTBEAT: "heartbeat",
+    _OP_LIVENESS: "liveness",
+}
 
 _HDR = struct.Struct("<iiiiqd")  # op, win_id, slot, mode, nbytes, p
 
@@ -315,6 +325,9 @@ class _Peers:
 
     def request(self, rank: int, op, win_id=0, slot=0, mode=0, p=0.0,
                 payload=b""):
+        reg = _telemetry.get_registry()
+        opname = _OP_NAMES.get(op, str(op))
+        t0 = time.perf_counter_ns() if reg.enabled else 0
         lock = self.locks.setdefault(rank, threading.Lock())
         with lock:
             conn = self.conns.get(rank)
@@ -329,7 +342,7 @@ class _Peers:
                 self.conns[rank] = conn
             try:
                 _send_msg(conn, op, win_id, slot, mode, p, payload)
-                return _recv_msg(conn)
+                reply = _recv_msg(conn)
             except socket.timeout as e:
                 # half-done exchange: the stream is unusable (a late reply
                 # would be mis-paired with the next request) — evict it
@@ -338,10 +351,16 @@ class _Peers:
                     conn.close()
                 except OSError:
                     pass
+                addr = self.table.get(rank)
+                if reg.enabled:
+                    reg.counter("tcp.timeouts", op=opname).inc()
+                    reg.journal("peer_timeout", peer_rank=rank, addr=addr,
+                                op=opname, deadline_s=peer_timeout_s())
                 raise PeerTimeoutError(
-                    f"rank {rank} did not respond to op {op} within "
-                    f"{peer_timeout_s()}s (set BFTPU_PEER_TIMEOUT_S to "
-                    f"adjust)", rank=rank) from e
+                    f"rank {rank} ({addr}) did not respond to op "
+                    f"{opname} within {peer_timeout_s()}s (set "
+                    f"BFTPU_PEER_TIMEOUT_S to adjust)",
+                    rank=rank, addr=addr, op=opname) from e
             except (ConnectionError, OSError):
                 # evict the dead socket so the NEXT request reconnects
                 # instead of failing forever on a cached corpse
@@ -351,6 +370,14 @@ class _Peers:
                 except OSError:
                     pass
                 raise
+        if reg.enabled:
+            reg.counter("tcp.round_trips", op=opname).inc()
+            reg.counter("tcp.acks").inc()
+            reg.counter("tcp.bytes_sent").add(_HDR.size + len(payload))
+            reg.counter("tcp.bytes_received").add(_HDR.size + len(reply[5]))
+            reg.histogram("tcp.rtt_s", op=opname).observe(
+                (time.perf_counter_ns() - t0) / 1e9)
+        return reply
 
     def close(self):
         for c in self.conns.values():
@@ -476,9 +503,16 @@ class _JobRuntime:
             except socket.timeout as e:
                 # NB socket.timeout IS TimeoutError (py3.10): only socket
                 # waits happen inside this try, so the clause is unambiguous
+                addr = "%s:%s" % self._coord_addr
+                reg = _telemetry.get_registry()
+                if reg.enabled:
+                    reg.counter("tcp.timeouts", op="barrier").inc()
+                    reg.journal("peer_timeout", peer_rank=0, addr=addr,
+                                op="barrier")
                 raise PeerTimeoutError(
                     "coordinator (rank 0) did not answer the barrier "
-                    "within its deadline", rank=-1) from e
+                    f"within its deadline ({addr})",
+                    rank=-1, addr=addr, op="barrier") from e
             if mode:
                 raise TimeoutError(
                     f"barrier timed out after {timeout}s (rank {self.rank})")

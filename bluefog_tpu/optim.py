@@ -37,6 +37,7 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 from bluefog_tpu import ops, ops_spmd, windows
+from bluefog_tpu.telemetry import registry as _telemetry
 from bluefog_tpu.core import basics
 from bluefog_tpu.core.basics import LOCAL_AXIS, MACHINES_AXIS, NODES_AXIS
 from bluefog_tpu.core.plan import CommPlan
@@ -328,6 +329,11 @@ class _EagerDistributedOptimizer:
                     out_specs=(spec, state_spec),
                 )
             )
+        reg = _telemetry.get_registry()
+        if reg.enabled:
+            reg.counter(
+                "optim.steps", optimizer=self._mode,
+                comm=self.communication_type.name).inc()
         # the whole fused step is one dispatch, so the step span is the
         # BLUEFOG_TIMELINE signal here (per-op spans exist only on the
         # eager op path)
@@ -453,7 +459,12 @@ class DistributedWinPutOptimizer:
             )
         adapted, state = self._fns[key](params, grads, state)
         self._step_count += 1
+        reg = _telemetry.get_registry()
+        if reg.enabled:
+            reg.counter("optim.steps", optimizer="winput").inc()
         if self._step_count % self.k == 0:
+            if reg.enabled:
+                reg.counter("optim.gossip_rounds", optimizer="winput").inc()
             flat, treedef = jax.tree_util.tree_flatten(adapted)
             if self.fuse:
                 for g, idxs in enumerate(self._groups):

@@ -26,6 +26,8 @@ import os
 import time
 from typing import Callable, Dict, Iterable, Tuple, TypeVar
 
+from bluefog_tpu.telemetry import registry as _telemetry
+
 __all__ = [
     "DeadlineExceeded",
     "op_deadline_s",
@@ -71,11 +73,19 @@ def with_deadline(fn: Callable[[float], T], describe: str,
             return fn(per_attempt)
         except TimeoutError as e:
             last = e
+            reg = _telemetry.get_registry()
+            if reg.enabled:
+                reg.counter("resilience.deadline_retries").inc()
             if on_timeout is not None:
                 on_timeout()
             if attempt + 1 < max(1, retries):
                 time.sleep(pause)
                 pause *= 2
+    reg = _telemetry.get_registry()
+    if reg.enabled:
+        reg.counter("resilience.deadline_exhausted").inc()
+        reg.journal("deadline_exhausted", op=describe, deadline_s=total,
+                    attempts=max(1, retries))
     raise DeadlineExceeded(
         f"{describe} exceeded its {total:.3f}s deadline "
         f"after {max(1, retries)} attempts: {last}")

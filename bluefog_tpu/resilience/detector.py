@@ -34,6 +34,8 @@ import threading
 import time
 from typing import Optional, Set
 
+from bluefog_tpu.telemetry import registry as _telemetry
+
 __all__ = [
     "PeerTimeoutError",
     "FailureDetector",
@@ -45,14 +47,19 @@ __all__ = [
 class PeerTimeoutError(RuntimeError):
     """A peer rank failed to respond within its deadline.
 
-    ``rank`` names the unresponsive peer (-1 = the coordinator).
-    Raised by the tcp transport's bounded waits and by degraded-step
-    retries once the retry budget is exhausted.
+    ``rank`` names the unresponsive peer (-1 = the coordinator),
+    ``addr`` its transport address ("host:port", when known) and ``op``
+    the in-flight operation that hit the deadline.  Raised by the tcp
+    transport's bounded waits and by degraded-step retries once the
+    retry budget is exhausted.
     """
 
-    def __init__(self, message: str, rank: int = -1):
+    def __init__(self, message: str, rank: int = -1,
+                 addr: Optional[str] = None, op: Optional[str] = None):
         super().__init__(message)
         self.rank = rank
+        self.addr = addr
+        self.op = op
 
 
 def heartbeat_interval_s() -> float:
@@ -101,7 +108,10 @@ class FailureDetector:
             try:
                 self._job.heartbeat()
             except Exception:
-                pass
+                return
+            reg = _telemetry.get_registry()
+            if reg.enabled:
+                reg.counter("resilience.heartbeats_sent").inc()
 
     def start(self) -> "FailureDetector":
         if self._thread is None and self._supported:
@@ -133,8 +143,15 @@ class FailureDetector:
         now = time.monotonic()
         if stamp <= 0.0:
             # never beat: startup grace measured from detector birth
-            return now - self._born <= self.timeout
-        return now - stamp <= self.timeout
+            alive = now - self._born <= self.timeout
+        else:
+            alive = now - stamp <= self.timeout
+        reg = _telemetry.get_registry()
+        if reg.enabled:
+            which = ("resilience.heartbeats_observed" if alive
+                     else "resilience.heartbeats_missed")
+            reg.counter(which).inc()
+        return alive
 
     def dead_ranks(self) -> Set[int]:
         """All ranks currently considered dead.  A rank once declared
@@ -143,14 +160,28 @@ class FailureDetector:
         dead = {r for r in range(self.nranks)
                 if r != self.rank and not self.is_alive(r)}
         with self._lock:
+            new = dead - self._declared
             self._declared |= dead
-            return set(self._declared)
+            declared = set(self._declared)
+        for r in sorted(new):
+            self._note_declared(r, how="heartbeat")
+        return declared
 
     def declare_dead(self, rank: int) -> None:
         """Externally assert a rank is dead (e.g. the tcp transport saw
         its connection reset, or a test injected the failure)."""
         with self._lock:
+            new = int(rank) not in self._declared
             self._declared.add(int(rank))
+        if new:
+            self._note_declared(int(rank), how="external")
+
+    def _note_declared(self, rank: int, how: str) -> None:
+        reg = _telemetry.get_registry()
+        if reg.enabled:
+            reg.counter("resilience.death_declarations").inc()
+            reg.journal("death_declared", peer_rank=rank, how=how,
+                        timeout_s=self.timeout)
 
     def __enter__(self) -> "FailureDetector":
         return self.start()

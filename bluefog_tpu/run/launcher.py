@@ -41,8 +41,8 @@ __all__ = ["main", "build_env", "parse_hosts", "ssh_command", "env_whitelist"]
 # Env forwarded to ssh-spawned ranks, by prefix (the reference forwards an
 # explicit whitelist plus every ``-x NAME``; prefixes cover our namespaced
 # config the same way).
-_FORWARD_PREFIXES = ("BLUEFOG_", "JAX_", "XLA_", "PYTHONPATH", "LIBTPU_",
-                     "TPU_")
+_FORWARD_PREFIXES = ("BLUEFOG_", "BFTPU_", "JAX_", "XLA_", "PYTHONPATH",
+                     "LIBTPU_", "TPU_")
 
 
 def build_env(args, base_env=None) -> dict:
@@ -461,6 +461,20 @@ def _cleanup_island_segments(job: str, by_rank) -> None:
         )
 
 
+def _collect_telemetry(env: dict, job: str) -> None:
+    """Best-effort cross-rank aggregation: merge the per-rank snapshot
+    files the ranks wrote at exit into one summary (JSON + Prometheus
+    text).  No-op when BFTPU_TELEMETRY is off; never fails the run."""
+    try:
+        from bluefog_tpu.telemetry.merge import merge_job_snapshots
+
+        out = merge_job_snapshots(env.get("BFTPU_TELEMETRY"), job)
+        if out:
+            print(f"bftpu-run: telemetry merged -> {out}", file=sys.stderr)
+    except Exception as e:  # telemetry must never mask the run's exit code
+        print(f"bftpu-run: telemetry merge failed: {e}", file=sys.stderr)
+
+
 def _run_islands(cmd, env, nranks: int, job, hosts, timeout: float) -> int:
     """Fork N island processes (the `mpirun -np N` shape of the reference's
     launcher [U]).  With ``-H``, ranks spawn on their hosts over ssh and
@@ -497,6 +511,7 @@ def _run_islands(cmd, env, nranks: int, job, hosts, timeout: float) -> int:
             code = _supervise(ranks, timeout)
         finally:
             _cleanup_island_segments(job, by_rank)
+            _collect_telemetry(env, job)
         if (code not in (0, 124, 130) and multi_host and attempt == 0
                 and time.monotonic() - t0 < 20.0):
             # same fast-failure signature as _run_multiprocess: the TCP

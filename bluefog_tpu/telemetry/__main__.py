@@ -1,0 +1,114 @@
+"""CLI: merge per-rank telemetry snapshots into one cross-rank summary.
+
+    python -m bluefog_tpu.telemetry SNAP_OR_DIR [...] [--format json|prom|both]
+                                    [--out PATH] [--check]
+
+Positional arguments are snapshot files or directories (directories are
+globbed for ``telemetry-*.json``; previously merged summaries are
+skipped by schema tag).  With no arguments the default telemetry dir
+(``$BFTPU_TELEMETRY`` when it names a dir, else /tmp/bftpu_telemetry)
+is scanned.
+
+``--check`` runs the telemetry analysis rules (snapshot schema +
+conservation invariant) over the corpus and exits non-zero on findings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+from bluefog_tpu.telemetry.merge import (
+    find_snapshots,
+    load_snapshot,
+    merge_snapshots,
+    to_prometheus,
+)
+from bluefog_tpu.telemetry.registry import _DEFAULT_DIR, telemetry_dir
+
+
+def _default_paths() -> List[str]:
+    d = telemetry_dir() or _DEFAULT_DIR
+    return [d] if os.path.isdir(d) else []
+
+
+def main(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m bluefog_tpu.telemetry",
+        description="Merge per-rank telemetry snapshots into one summary.")
+    ap.add_argument("paths", nargs="*",
+                    help="snapshot files or directories "
+                         "(default: the telemetry dir)")
+    ap.add_argument("--format", choices=("json", "prom", "both"),
+                    default="json", help="output format (default: json)")
+    ap.add_argument("--out", default=None,
+                    help="write output to PATH instead of stdout "
+                         "(with --format both, PATH and PATH.prom)")
+    ap.add_argument("--check", action="store_true",
+                    help="run telemetry analysis rules over the corpus; "
+                         "exit non-zero on findings")
+    args = ap.parse_args(argv)
+
+    paths = find_snapshots(args.paths or _default_paths())
+    snaps = []
+    for p in paths:
+        try:
+            snap = load_snapshot(p)
+        except (OSError, ValueError) as e:
+            print(f"warning: skipping {p}: {e}", file=sys.stderr)
+            continue
+        if snap is not None:
+            snaps.append(snap)
+    if not snaps:
+        print("error: no telemetry snapshots found "
+              "(run with BFTPU_TELEMETRY=1, or pass snapshot paths)",
+              file=sys.stderr)
+        return 2
+
+    merged = merge_snapshots(snaps)
+    json_text = json.dumps(merged, indent=2)
+    prom_text = to_prometheus(merged)
+
+    if args.out:
+        if args.format in ("json", "both"):
+            with open(args.out, "w", encoding="utf-8") as f:
+                f.write(json_text + "\n")
+        if args.format == "prom":
+            with open(args.out, "w", encoding="utf-8") as f:
+                f.write(prom_text)
+        elif args.format == "both":
+            with open(args.out + ".prom", "w", encoding="utf-8") as f:
+                f.write(prom_text)
+        print(f"merged {len(snaps)} snapshot(s) "
+              f"(ranks {merged['ranks']}) -> {args.out}", file=sys.stderr)
+    else:
+        if args.format in ("json", "both"):
+            print(json_text)
+        if args.format in ("prom", "both"):
+            print(prom_text, end="")
+
+    rc = 0
+    if args.check:
+        from bluefog_tpu.analysis import telemetry_rules
+
+        findings = telemetry_rules.check_snapshot_corpus(snaps)
+        for f in findings:
+            print(f"CHECK {f.severity}: [{f.rule}] {f.subject}: {f.message}",
+                  file=sys.stderr)
+        if findings:
+            rc = 1
+        else:
+            led = merged["ledger"]
+            print(f"check ok: {len(snaps)} snapshots, ledger balanced "
+                  f"(deposits={led['deposits']:.0f} = "
+                  f"collected={led['collected']:.0f} + "
+                  f"drained={led['drained']:.0f} + "
+                  f"pending={led['pending']:.0f})", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,228 @@
+"""Cross-rank snapshot aggregation + Prometheus text exposition.
+
+Per-rank snapshot files (``telemetry-<job>-r<rank>.json``, written by
+:class:`bluefog_tpu.telemetry.Registry` at exit) merge into ONE summary:
+counters sum, gauges aggregate (sum/min/max), histograms add bucket-wise.
+The merged dict also carries a ``ledger`` section evaluating the mailbox
+mass-conservation identity (deposits == collected + drained + pending on
+a quiescent job) — the same identity the analysis
+``telemetry.conservation`` rule verifies.
+
+Stdlib-only, like the rest of the package.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from bluefog_tpu.telemetry.registry import (
+    LEDGER_COLLECTED,
+    LEDGER_DEPOSITS,
+    LEDGER_DRAINED,
+    LEDGER_PENDING,
+    SNAPSHOT_SCHEMA,
+    _safe_name,
+)
+
+__all__ = [
+    "MERGED_SCHEMA",
+    "find_snapshots",
+    "load_snapshot",
+    "merge_snapshots",
+    "ledger_balance",
+    "to_prometheus",
+    "merge_job_snapshots",
+]
+
+MERGED_SCHEMA = "bftpu-telemetry-merged/1"
+
+
+def find_snapshots(paths: Iterable[str]) -> List[str]:
+    """Expand files/directories into snapshot paths.  A directory yields
+    every ``telemetry-*.json`` in it (merged outputs are filtered out at
+    load time by their schema tag)."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(glob.glob(os.path.join(p, "telemetry-*.json"))))
+        else:
+            out.append(p)
+    return out
+
+
+def load_snapshot(path: str) -> Optional[dict]:
+    """One snapshot dict, or None when the file is not a per-rank
+    snapshot (wrong schema — e.g. a previous merged summary)."""
+    with open(path, "r", encoding="utf-8") as f:
+        snap = json.load(f)
+    if not isinstance(snap, dict) or snap.get("schema") != SNAPSHOT_SCHEMA:
+        return None
+    return snap
+
+
+def _key(entry: dict) -> Tuple:
+    labels = entry.get("labels") or {}
+    return (entry["name"], tuple(sorted((k, str(v))
+                                        for k, v in labels.items())))
+
+
+def merge_snapshots(snaps: List[dict]) -> dict:
+    """Aggregate per-rank snapshots into one cross-rank summary."""
+    counters: Dict[Tuple, dict] = {}
+    gauges: Dict[Tuple, dict] = {}
+    hists: Dict[Tuple, dict] = {}
+    ranks, jobs = [], []
+    for snap in snaps:
+        ranks.append(snap.get("rank", -1))
+        job = snap.get("job")
+        if job and job not in jobs:
+            jobs.append(job)
+        for c in snap.get("counters", []):
+            k = _key(c)
+            cur = counters.get(k)
+            if cur is None:
+                counters[k] = {"name": c["name"],
+                               "labels": dict(c.get("labels") or {}),
+                               "value": c["value"]}
+            else:
+                cur["value"] += c["value"]
+        for g in snap.get("gauges", []):
+            k = _key(g)
+            v = float(g["value"])
+            cur = gauges.get(k)
+            if cur is None:
+                gauges[k] = {"name": g["name"],
+                             "labels": dict(g.get("labels") or {}),
+                             "sum": v, "min": v,
+                             "max": float(g.get("max", v)), "n": 1}
+            else:
+                cur["sum"] += v
+                cur["min"] = min(cur["min"], v)
+                cur["max"] = max(cur["max"], float(g.get("max", v)))
+                cur["n"] += 1
+        for h in snap.get("histograms", []):
+            k = _key(h)
+            cur = hists.get(k)
+            if cur is None:
+                hists[k] = {"name": h["name"],
+                            "labels": dict(h.get("labels") or {}),
+                            "buckets": list(h["buckets"]),
+                            "counts": list(h["counts"]),
+                            "sum": float(h["sum"])}
+            elif list(h["buckets"]) == cur["buckets"]:
+                cur["counts"] = [a + b for a, b in
+                                 zip(cur["counts"], h["counts"])]
+                cur["sum"] += float(h["sum"])
+            # mismatched bucket layouts are skipped (schema rule flags them)
+    merged = {
+        "schema": MERGED_SCHEMA,
+        "ranks": sorted(ranks),
+        "jobs": jobs,
+        "counters": [counters[k] for k in sorted(counters)],
+        "gauges": [gauges[k] for k in sorted(gauges)],
+        "histograms": [hists[k] for k in sorted(hists)],
+    }
+    merged["ledger"] = ledger_balance(merged)
+    return merged
+
+
+def _counter_total(merged: dict, name: str) -> float:
+    return sum(c["value"] for c in merged.get("counters", [])
+               if c["name"] == name)
+
+
+def ledger_balance(merged: dict) -> dict:
+    """Evaluate the mailbox conservation identity over a merged summary."""
+    deposits = _counter_total(merged, LEDGER_DEPOSITS)
+    collected = _counter_total(merged, LEDGER_COLLECTED)
+    drained = _counter_total(merged, LEDGER_DRAINED)
+    pending = _counter_total(merged, LEDGER_PENDING)
+    return {
+        "deposits": deposits,
+        "collected": collected,
+        "drained": drained,
+        "pending": pending,
+        "balanced": deposits == collected + drained + pending,
+    }
+
+
+def _prom_name(name: str) -> str:
+    out = "".join(ch if ch.isalnum() or ch == "_" else "_" for ch in name)
+    return f"bftpu_{out}"
+
+
+def _prom_labels(labels: Dict[str, object], extra: str = "") -> str:
+    items = [f'{k}="{v}"' for k, v in sorted(labels.items())]
+    if extra:
+        items.append(extra)
+    return "{" + ",".join(items) + "}" if items else ""
+
+
+def to_prometheus(merged: dict) -> str:
+    """Prometheus text exposition (0.0.4) of a merged summary."""
+    lines: List[str] = []
+    typed = set()
+
+    def _type(name: str, kind: str):
+        if name not in typed:
+            typed.add(name)
+            lines.append(f"# TYPE {name} {kind}")
+
+    for c in merged.get("counters", []):
+        n = _prom_name(c["name"])
+        _type(n, "counter")
+        lines.append(f"{n}{_prom_labels(c['labels'])} {c['value']}")
+    for g in merged.get("gauges", []):
+        n = _prom_name(g["name"])
+        _type(n, "gauge")
+        base = dict(g["labels"])
+        for agg in ("sum", "min", "max"):
+            extra = 'agg="%s"' % agg
+            lines.append(f"{n}{_prom_labels(base, extra)} {g[agg]}")
+    for h in merged.get("histograms", []):
+        n = _prom_name(h["name"])
+        _type(n, "histogram")
+        cum = 0
+        for le, cnt in zip(h["buckets"], h["counts"]):
+            cum += cnt
+            extra = 'le="%s"' % le
+            lines.append(f"{n}_bucket{_prom_labels(h['labels'], extra)} {cum}")
+        cum += h["counts"][-1]
+        inf = 'le="+Inf"'
+        lines.append(f"{n}_bucket{_prom_labels(h['labels'], inf)} {cum}")
+        lines.append(f"{n}_sum{_prom_labels(h['labels'])} {h['sum']}")
+        lines.append(f"{n}_count{_prom_labels(h['labels'])} {cum}")
+    return "\n".join(lines) + "\n"
+
+
+def merge_job_snapshots(dir_value: Optional[str], job: str) -> Optional[str]:
+    """Launcher-side collection: merge ``telemetry-<job>-r*.json`` under
+    the telemetry dir into ``telemetry-<job>-merged.json`` (plus a
+    ``.prom`` text exposition next to it).  Returns the merged path, or
+    None when telemetry was off or no rank wrote a snapshot."""
+    if not dir_value or dir_value == "0":
+        return None
+    from bluefog_tpu.telemetry.registry import _DEFAULT_DIR
+
+    d = _DEFAULT_DIR if dir_value == "1" else dir_value
+    pattern = os.path.join(d, f"telemetry-{_safe_name(job)}-r*.json")
+    snaps = []
+    for p in sorted(glob.glob(pattern)):
+        try:
+            snap = load_snapshot(p)
+        except (OSError, ValueError):
+            continue
+        if snap is not None:
+            snaps.append(snap)
+    if not snaps:
+        return None
+    merged = merge_snapshots(snaps)
+    out = os.path.join(d, f"telemetry-{_safe_name(job)}-merged.json")
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(merged, f, indent=2)
+    with open(out[:-len(".json")] + ".prom", "w", encoding="utf-8") as f:
+        f.write(to_prometheus(merged))
+    return out

@@ -49,6 +49,7 @@ class TimelineWriter:
         self.path = path
         self._lock = threading.Lock()
         self._events = []
+        self._counter_events = []
         self._t0 = time.perf_counter_ns()
         self._native = None
         try:
@@ -61,6 +62,29 @@ class TimelineWriter:
 
     def _now_us(self) -> float:
         return (time.perf_counter_ns() - self._t0) / 1e3
+
+    def now_us(self) -> float:
+        """Current timestamp on this writer's clock (µs since creation).
+        Public so other layers (telemetry counter sampling) can stamp
+        events onto the same timebase as the spans."""
+        return self._now_us()
+
+    def record_counter(self, name: str, ts_us: float, value: float) -> None:
+        """Emit a chrome-trace counter sample (``"ph": "C"``).  Telemetry
+        counters land on the same profile as the activity spans."""
+        if self._native is not None and hasattr(self._native, "counter"):
+            self._native.counter(name, ts_us, value)
+            return
+        with self._lock:
+            self._counter_events.append(
+                {
+                    "name": name,
+                    "ph": "C",
+                    "ts": ts_us,
+                    "pid": os.getpid(),
+                    "args": {"value": value},
+                }
+            )
 
     def record(self, name: str, start_us: float, dur_us: float, tid: int = 0) -> None:
         if self._native is not None:
@@ -81,13 +105,28 @@ class TimelineWriter:
     def flush(self) -> None:
         if self._native is not None:
             self._native.flush()
+            # Counter events buffered python-side (native lib without
+            # bf_timeline_counter) merge into the native-written file.
+            with self._lock:
+                extra, self._counter_events = self._counter_events, []
+            if extra:
+                try:
+                    with open(self.path, "r") as f:
+                        doc = json.load(f)
+                    doc.setdefault("traceEvents", []).extend(extra)
+                    with open(self.path, "w") as f:
+                        json.dump(doc, f)
+                except (OSError, ValueError) as e:  # pragma: no cover
+                    logger.warning("timeline counter merge failed: %s", e)
             return
         with self._lock:
-            if not self._events:
+            if not self._events and not self._counter_events:
                 return
             try:
                 with open(self.path, "w") as f:
-                    json.dump({"traceEvents": self._events}, f)
+                    json.dump(
+                        {"traceEvents": self._events + self._counter_events},
+                        f)
             except OSError as e:  # pragma: no cover
                 logger.warning("timeline flush failed: %s", e)
 

@@ -32,6 +32,7 @@ from bluefog_tpu.optim import (
     gradient_allreduce_spmd,
     make_spmd_comm_fn,
 )
+from bluefog_tpu.telemetry import registry as _telemetry
 from bluefog_tpu.timeline import timeline_context
 
 __all__ = [
@@ -283,6 +284,10 @@ def make_decentralized_train_step(
                 ),
                 donate_argnums=(0, 1, 2) if donate else (),
             )
+        reg = _telemetry.get_registry()
+        if reg.enabled:
+            # one host call may run several fused sub-steps
+            reg.counter("train.steps").add(max(1, int(steps_per_call)))
         # step-level span: jitted training records no per-op host spans, so
         # this is where BLUEFOG_TIMELINE traces come from (the reference's
         # per-tensor spans are a background-thread artifact; dispatch of the

@@ -48,6 +48,7 @@ from bluefog_tpu.common.logging_util import logger
 from bluefog_tpu.core import basics
 from bluefog_tpu.core.basics import NODES_AXIS
 from bluefog_tpu.core.plan import CommPlan
+from bluefog_tpu.telemetry import registry as _telemetry
 from bluefog_tpu.timeline import timeline_context
 
 __all__ = [
@@ -77,8 +78,16 @@ __all__ = [
 
 WeightsArg = Union[None, Sequence[Dict[int, float]]]
 
-# ``record_win_ops`` trace target; None = recording off (zero-cost path)
+# ``record_win_ops`` trace target; None = recording off.  The events come
+# from the telemetry op stream (telemetry.note_op) — one bookkeeping path
+# shared by this module, the island runtime, and the win_ops.total counter.
 _OP_LOG: Optional[List[Tuple[str, str]]] = None
+
+
+def _op_log_listener(op: str, name: str) -> None:
+    log = _OP_LOG
+    if log is not None:
+        log.append((op, name))
 
 
 @contextlib.contextmanager
@@ -88,30 +97,34 @@ def record_win_ops():
     (``bluefog_tpu.analysis.epoch_rules.check_trace``) consumes this trace,
     so a real training loop's window usage can be checked against the
     use-before-create / use-after-free / mixed-deposit-epoch rules exactly
-    as the analysis CLI checks canned traces.  Nested recorders share the
-    outer list; ``win_free(None)`` logs with name ``"*"``."""
+    as the analysis CLI checks canned traces.  A thin consumer of the
+    telemetry op stream: both this module's SPMD ops and the island
+    runtime's publish through ``telemetry.note_op``, so one recorder covers
+    both execution modes.  Nested recorders share the outer list;
+    ``win_free(None)`` logs with name ``"*"``."""
     global _OP_LOG
     prev = _OP_LOG
     log = [] if prev is None else prev
     _OP_LOG = log
+    if prev is None:
+        _telemetry.add_op_listener(_op_log_listener)
     try:
         yield log
     finally:
         _OP_LOG = prev
+        if prev is None:
+            _telemetry.remove_op_listener(_op_log_listener)
 
 
 def _log_op(op: str, name: Optional[str]) -> None:
-    if _OP_LOG is not None:
-        _OP_LOG.append((op, "*" if name is None else name))
+    _telemetry.note_op(op, name)
 
 
 def note_win_op(op: str, name: Optional[str]) -> None:
-    """Record a window op from OUTSIDE this module into the active
-    ``record_win_ops()`` trace (no-op when recording is off).  The island
-    runtime (:mod:`bluefog_tpu.islands`) calls this from its win ops so a
-    single recorder covers both execution modes — the epoch linter lints
-    island-mode programs with the same rules as the SPMD emulation."""
-    _log_op(op, name)
+    """Deprecated shim: window ops from other modules now publish through
+    :func:`bluefog_tpu.telemetry.note_op` directly; kept so existing
+    callers keep feeding the active ``record_win_ops()`` trace."""
+    _telemetry.note_op(op, name)
 
 
 class _Window:
