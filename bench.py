@@ -462,6 +462,17 @@ def main():
             print(json.dumps(tel), file=sys.stderr)
         except Exception as e:  # noqa: BLE001
             print(f"telemetry overhead phase failed: {e!r}", file=sys.stderr)
+    trc = None
+    if time.perf_counter() - t_start < budget_s:
+        try:
+            # tracing overhead gate (docs/OBSERVABILITY.md): the same
+            # interleaved on/off protocol with BFTPU_TRACING; the
+            # NullTracer no-op contract is < 2%
+            from gossip_bandwidth import measure_tracing_overhead
+            trc = measure_tracing_overhead(nprocs=2)
+            print(json.dumps(trc), file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"tracing overhead phase failed: {e!r}", file=sys.stderr)
     rec = None
     if time.perf_counter() - t_start < budget_s:
         try:
@@ -531,6 +542,9 @@ def main():
     if tel is not None:
         headline["telemetry_overhead_pct"] = tel["value"]
         headline["telemetry_overhead_metric"] = tel["metric"]
+    if trc is not None:
+        headline["tracing_overhead_pct"] = trc["value"]
+        headline["tracing_overhead_metric"] = trc["metric"]
     if rec is not None:
         headline["recovery_ms"] = rec["value"]
         headline["recovery_metric"] = rec["metric"]

@@ -199,6 +199,61 @@ def measure_telemetry_overhead(nprocs: int = 2, mb: float = 4.0,
     }
 
 
+def measure_tracing_overhead(nprocs: int = 2, mb: float = 4.0,
+                             iters: int = 120, warmup: int = 10,
+                             repeats: int = 5) -> dict:
+    """Tracing-on vs tracing-off cost of the island win_put loop.
+
+    Same protocol as :func:`measure_telemetry_overhead` — interleaved
+    arms, best-of-``repeats`` floors — but toggling ``BFTPU_TRACING``.
+    "On" pays the full span path per op: a begin/end pair with a flight
+    -ring append each, one sidecar stamp per out-edge, and one sidecar
+    peek per in-slot on the combine.  "Off" must hit the shared
+    ``NullTracer`` (one attribute load per op); the < 2% contract in
+    docs/OBSERVABILITY.md holds for both observability layers.
+    """
+    import functools
+    import shutil
+    import tempfile
+
+    from bluefog_tpu import islands
+
+    def one_dt() -> float:
+        res = islands.spawn(
+            functools.partial(_island_worker, mb=mb, iters=iters,
+                              warmup=warmup, topo_name="ring"),
+            nprocs, timeout=600.0,
+        )
+        return max(d for _, d in res)
+
+    prev = os.environ.pop("BFTPU_TRACING", None)
+    td = tempfile.mkdtemp(prefix="bftpu_tracing_bench_")
+    t_off = t_on = None
+    try:
+        for _ in range(repeats):
+            os.environ.pop("BFTPU_TRACING", None)
+            dt = one_dt()
+            t_off = dt if t_off is None else min(t_off, dt)
+            os.environ["BFTPU_TRACING"] = td
+            dt = one_dt()
+            t_on = dt if t_on is None else min(t_on, dt)
+    finally:
+        os.environ.pop("BFTPU_TRACING", None)
+        if prev is not None:
+            os.environ["BFTPU_TRACING"] = prev
+        shutil.rmtree(td, ignore_errors=True)
+    pct = (t_on - t_off) / t_off * 100.0 if t_off else 0.0
+    return {
+        "metric": f"island win_put tracing overhead ({nprocs} processes, "
+                  f"{mb:g} MB payload, best of {repeats})",
+        "value": round(pct, 2),
+        "unit": "%",
+        "t_off_s": round(t_off, 4),
+        "t_on_s": round(t_on, 4),
+        "contract_pct": 2.0,
+    }
+
+
 def _probe_gbs(mb: float, iters: int, chunk: int = None,
                depth: int = None) -> float:
     """One pipelined self-edge configuration: write leg and drain leg of

@@ -35,6 +35,22 @@ if not hasattr(_jax, "shard_map"):
 
     _jax.shard_map = _shard_map_compat
 
+try:
+    # jax 0.4.x shard_map has no replication rule for the remat name
+    # primitive, so ``checkpoint_name`` inside a shard_map'd function dies
+    # with "No replication rule for name".  name_p is identity-shaped —
+    # the standard check/rewrite rules are exactly right for it; newer jax
+    # registers them itself (and this block no-ops on ImportError there).
+    from jax._src.ad_checkpoint import name_p as _name_p
+    from jax.experimental import shard_map as _sm_mod
+
+    if _name_p not in getattr(_sm_mod, "_check_rules", {}):
+        _sm_mod.register_standard_check(_name_p)
+        _sm_mod.register_standard_rewrite(_name_p)
+    del _name_p, _sm_mod
+except (ImportError, AttributeError):  # pragma: no cover - other jax gens
+    pass
+
 from bluefog_tpu.version import __version__
 
 from bluefog_tpu.core.basics import (

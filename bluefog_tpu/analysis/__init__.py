@@ -22,6 +22,9 @@ consumers (CLI, pytest, CI):
   monotonicity, the mailbox-ledger conservation identity
   (deposits == collected + drained + pending on a quiescent job), and
   the env-var lint (every BFTPU_*/BLUEFOG_* knob documented);
+- **trace** (:mod:`.trace_rules`) — distributed-trace buffers: per-rank
+  span nesting, cross-rank flow-endpoint resolution, and clock blocks
+  within the min-RTT estimator's own error bound;
 - the **fixture corpus** (:mod:`.fixtures`) — seeded bugs proving every
   rule fires.
 
@@ -50,6 +53,7 @@ from bluefog_tpu.analysis import (  # noqa: F401
     resilience_rules,
     seqlock_model,
     telemetry_rules,
+    trace_rules,
 )
 
 __all__ = [

@@ -32,6 +32,7 @@ from bluefog_tpu.analysis import (
     resilience_rules,
     seqlock_model,
     telemetry_rules,
+    trace_rules,
 )
 from bluefog_tpu.analysis.engine import Finding
 
@@ -243,6 +244,52 @@ def _envlint_undocumented_var() -> List[Finding]:
         documented=set(), label="fixture[undocumented-var]")
 
 
+def _trace_fixture_corpus() -> List[dict]:
+    """The trace family's healthy synthetic corpus, re-used by mutation
+    (same rationale as the plan fixtures: break the REAL shape so a
+    schema change that disarms a rule breaks the fixture too)."""
+    return trace_rules._synthetic_traces()
+
+
+def _trace_unbalanced_nesting() -> List[Finding]:
+    """A buffer where one span's end crossed another's — the signature
+    of a dropped/reused begin token (two timing contexts raced)."""
+    t = _trace_fixture_corpus()[0]
+    # stretch the first win_put so it ends INSIDE the following
+    # win_update: partial overlap, neither nested nor disjoint
+    put = next(s for s in t["spans"] if s["name"] == "win_put")
+    upd = next(s for s in t["spans"] if s["name"] == "win_update")
+    put["t1"] = (upd["t0"] + upd["t1"]) // 2
+    return trace_rules.check_span_nesting(
+        t, label="fixture[crossed-spans]")
+
+
+def _trace_dangling_flow() -> List[Finding]:
+    """A consume whose flow identity no present buffer ever emitted —
+    the corrupted-context-word signature (origin rank IS in the corpus,
+    so this must be an error, not a missing-buffer warning)."""
+    corpus = _trace_fixture_corpus()
+    for s in corpus[1]["spans"]:
+        for c in s.get("consume", ()):
+            c["op_id"] += 1000  # no such emit anywhere
+    return [f for f in trace_rules.check_flow_endpoints(
+        corpus, label="fixture[dangling-flow]")
+        if f.severity == "error"]
+
+
+def _trace_clock_skew() -> List[Finding]:
+    """A buffer whose applied clock offset is far outside what its own
+    estimator state allows: flows complete before their producers by
+    much more than the combined error bound."""
+    corpus = _trace_fixture_corpus()
+    # claim a huge NEGATIVE offset with a tiny rtt: rank 1's spans slide
+    # 5 ms earlier while the error bound stays at rtt/2 = 4 µs
+    corpus[1]["clock"] = {"offset_s": -5e-3, "err_s": 4e-6,
+                          "best_rtt_s": 8e-6, "samples": 3}
+    return trace_rules.check_clock_offsets(
+        corpus, label="fixture[clock-skew]")
+
+
 FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
     # plan family
     "plan-duplicate-destination": _plan_duplicate_destination,
@@ -288,6 +335,10 @@ FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
     "telemetry-snapshot-bad-schema": _telemetry_snapshot_bad_schema,
     "telemetry-conservation-broken": _telemetry_conservation_broken,
     "envlint-undocumented-var": _envlint_undocumented_var,
+    # trace family: crossed spans, corrupted flow identity, clock skew
+    "trace-unbalanced-nesting": _trace_unbalanced_nesting,
+    "trace-dangling-flow": _trace_dangling_flow,
+    "trace-clock-skew": _trace_clock_skew,
     # epoch family: ill-ordered window traces
     "epoch-use-after-free": lambda: epoch_rules.check_trace(
         [("win_create", "w"), ("win_put", "w"), ("win_free", "w"),

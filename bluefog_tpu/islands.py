@@ -50,6 +50,7 @@ from bluefog_tpu.resilience import healing as _healing
 from bluefog_tpu.resilience.detector import FailureDetector
 from bluefog_tpu.telemetry import registry as _telemetry
 from bluefog_tpu.timeline import timeline_context
+from bluefog_tpu.tracing import tracer as _tracing
 
 __all__ = [
     "init",
@@ -108,6 +109,10 @@ class _IslandWindow:
         self.p_self = 1.0
         self._scratch: Optional[np.ndarray] = None  # win_update staging
         self._tel_cache = None  # (registry, {key: metric handle}) memo
+        # last trace-context word consumed per slot: a combine that finds
+        # the word unchanged consumed no NEW deposit on that edge, so no
+        # duplicate flow arrow is recorded
+        self._trace_seen: Dict[int, int] = {}
         self.shm = shm_native.make_window(
             ctx.job, name, ctx.rank, ctx.size, maxd,
             tensor.shape, tensor.dtype,
@@ -198,6 +203,12 @@ def init(rank_: Optional[int] = None, size_: Optional[int] = None,
         # collide on the env-derived default (rank 0)
         reg.rank, reg.job = r, j
         reg.journal("island_init", size=n)
+    tr = _tracing.get_tracer()
+    if tr.enabled:
+        # same identity handoff as telemetry, plus the SIGTERM flight-dump
+        # handler and the per-rank flight ring at its final path
+        tr.set_identity(r, n, j)
+        tr.instant("island_init")
     _context = _IslandContext(r, n, j)
     _context.shm_job.barrier()
 
@@ -237,6 +248,10 @@ def shutdown(unlink: bool = False) -> None:
             shm_native.unlink_all(f"{ctx.job}_h{hosts[ctx.rank]}", names)
     if unlink:
         shm_native.unlink_all(ctx.job, names)
+    tr = _tracing.get_tracer()
+    if tr.enabled:
+        tr.write_buffer()
+        tr.close()
     _context = None
 
 
@@ -348,6 +363,10 @@ def heal(dead=None):
                         reg, win, slot, s, _telemetry.LEDGER_DRAINED)
                 drain(slot, src=s)
     ctx.healed = _healing.heal_topology(ctx.topology, sorted(ctx.dead))
+    tr = _tracing.get_tracer()
+    if tr.enabled and new:
+        for r in sorted(new):
+            tr.instant("heal", aux=r)
     if reg.enabled and new:
         dt = (time.perf_counter_ns() - t0) / 1e9
         reg.counter("resilience.heals").inc()
@@ -540,6 +559,9 @@ def win_put(tensor, name: str, dst_weights: WeightDict = None) -> bool:
         ctx = _ctx()
         win = _win(name)
         reg = _telemetry.get_registry()
+        tr = _tracing.get_tracer()
+        ttok = tr.begin("win_put", window=name) if tr.enabled else None
+        emits = [] if ttok is not None else None
         t0 = time.perf_counter_ns() if reg.enabled else 0
         t = _to_host(_island_pack(name, tensor)).astype(win.shm.dtype, copy=False)
         # alias, don't copy: upstream the window aliases the user tensor's
@@ -555,6 +577,14 @@ def win_put(tensor, name: str, dst_weights: WeightDict = None) -> bool:
         exposed = False
         for d in targets:
             wgt = 1.0 if dst_weights is None else float(dst_weights[d])
+            if ttok is not None:
+                # stamp BEFORE the deposit: the consumer must never see a
+                # committed payload without its context word
+                op_id = tr.next_op_id()
+                win.shm.trace_stamp(
+                    d, win.slot_of[d][ctx.rank],
+                    _tracing.pack_ctx(tr.round, op_id, ctx.rank))
+                emits.append({"dst": d, "op_id": op_id})
             if dual is not None and not exposed:
                 # v2 transport: ONE read of t feeds both the exposed slot
                 # and the first destination's mailbox, chunk-interleaved
@@ -578,6 +608,8 @@ def win_put(tensor, name: str, dst_weights: WeightDict = None) -> bool:
                 _edge_deposit(reg, win, "win_put", ctx.rank, d, t.nbytes)
             _op_hist(reg, win, "win_put").observe(
                 (time.perf_counter_ns() - t0) / 1e9)
+        if ttok is not None:
+            tr.end(ttok, emit=emits)
         _note_op("win_put", name)
     return True
 
@@ -689,6 +721,9 @@ def win_accumulate(tensor, name: str, dst_weights: WeightDict = None) -> bool:
         ctx = _ctx()
         win = _win(name)
         reg = _telemetry.get_registry()
+        tr = _tracing.get_tracer()
+        ttok = tr.begin("win_accumulate", window=name) if tr.enabled else None
+        emits = [] if ttok is not None else None
         t0 = time.perf_counter_ns() if reg.enabled else 0
         t = _to_host(_island_pack(name, tensor)).astype(win.shm.dtype, copy=False)
         targets = _check_dst(win, dst_weights)
@@ -697,6 +732,15 @@ def win_accumulate(tensor, name: str, dst_weights: WeightDict = None) -> bool:
         scaled = _scaled_transport(win)
         for d in targets:
             wgt = 1.0 if dst_weights is None else float(dst_weights[d])
+            if ttok is not None:
+                # accumulating deposits overwrite the slot's word: the
+                # flow records the LAST contributor (the sidecar word is
+                # advisory, not a full contributor list)
+                op_id = tr.next_op_id()
+                win.shm.trace_stamp(
+                    d, win.slot_of[d][ctx.rank],
+                    _tracing.pack_ctx(tr.round, op_id, ctx.rank))
+                emits.append({"dst": d, "op_id": op_id})
             if scaled:
                 win.shm.write(d, win.slot_of[d][ctx.rank], t,
                               p=win.p_self * wgt, accumulate=True,
@@ -710,6 +754,8 @@ def win_accumulate(tensor, name: str, dst_weights: WeightDict = None) -> bool:
                 _edge_deposit(reg, win, "win_accumulate", ctx.rank, d, t.nbytes)
             _op_hist(reg, win, "win_accumulate").observe(
                 (time.perf_counter_ns() - t0) / 1e9)
+        if ttok is not None:
+            tr.end(ttok, emit=emits)
         _note_op("win_accumulate", name)
     return True
 
@@ -734,9 +780,22 @@ def win_get(name: str, src_weights: WeightDict = None) -> bool:
         if ctx.dead:
             sources = [s for s in sources if s not in ctx.dead]
         scaled = _scaled_transport(win)
+        tr = _tracing.get_tracer()
+        ttok = tr.begin("win_get", window=name) if tr.enabled else None
+        emits = [] if ttok is not None else None
         for s in sources:
             wgt = 1.0 if src_weights is None else float(src_weights[s])
             a, p, _ = win.shm.read_exposed(s)
+            if ttok is not None:
+                # the pull deposits into MY slot: this rank is both the
+                # emitting and (later, at win_update) the consuming side,
+                # so origin is self — the edge s->me is recorded in args
+                op_id = tr.next_op_id()
+                win.shm.trace_stamp(
+                    ctx.rank, win.slot_of[ctx.rank][s],
+                    _tracing.pack_ctx(tr.round, op_id, ctx.rank),
+                    writer=s)
+                emits.append({"dst": ctx.rank, "op_id": op_id, "src": s})
             # writer-of-record is s: deposit and later read must agree on
             # which transport leg holds the slot (hierarchical routing)
             if scaled:
@@ -753,6 +812,8 @@ def win_get(name: str, src_weights: WeightDict = None) -> bool:
         if reg.enabled:
             _op_hist(reg, win, "win_get").observe(
                 (time.perf_counter_ns() - t0) / 1e9)
+        if ttok is not None:
+            tr.end(ttok, emit=emits)
         _note_op("win_get", name)
     return True
 
@@ -804,11 +865,29 @@ def win_update(
         ctx = _ctx()
         win = _win(name)
         reg = _telemetry.get_registry()
+        tr = _tracing.get_tracer()
+        ttok = tr.begin("win_update", window=name) if tr.enabled else None
         t0 = time.perf_counter_ns() if reg.enabled else 0
         sw, nw = _resolve_update_weights(win, self_weight, neighbor_weights)
         # after healing, dead in-neighbors are absent from nw: their slots
         # were force-drained and must not be combined (or even locked)
         nbrs = [s for s in win.in_neighbors if s in nw]
+        consumes = None
+        if ttok is not None:
+            # peek BEFORE the combine: collect (reset) may recycle the
+            # slot to a new deposit under a racing writer.  An unchanged
+            # word means no NEW deposit was consumed on that edge since
+            # the last combine — skip it, or every later round would
+            # re-draw the same flow arrow.
+            consumes = []
+            for s in nbrs:
+                slot = win.slot_of[ctx.rank][s]
+                word = win.shm.trace_peek(slot, src=s)
+                if word and word != win._trace_seen.get(slot):
+                    win._trace_seen[slot] = word
+                    rnd, op_id, origin = _tracing.unpack_ctx(word)
+                    consumes.append({"src": s, "origin": origin,
+                                     "op_id": op_id, "round": rnd})
         wdt = (win.shm.dtype if np.issubdtype(win.shm.dtype, np.inexact)
                else np.float64)
         fused = (getattr(win.shm, "update_fused", None)
@@ -860,6 +939,9 @@ def win_update(
                             _telemetry.LEDGER_COLLECTED)
                 _op_hist(reg, win, "win_update").observe(
                     (time.perf_counter_ns() - t0) / 1e9)
+            if ttok is not None:
+                tr.end(ttok, consume=consumes)
+                tr.advance_round()
             _note_op("win_update", name)
             out = win.self_tensor
             out = np.array(out, copy=True) if clone else out
@@ -908,6 +990,9 @@ def win_update(
         if reg.enabled:
             _op_hist(reg, win, "win_update").observe(
                 (time.perf_counter_ns() - t0) / 1e9)
+        if ttok is not None:
+            tr.end(ttok, consume=consumes)
+            tr.advance_round()
         _note_op("win_update", name)
         out = win.self_tensor
         out = np.array(out, copy=True) if clone else out
@@ -1342,6 +1427,12 @@ def _spawn_worker(fn, r, nranks, job, args, q, tolerant=False):
     except Exception as e:  # noqa: BLE001 - report to parent
         import traceback
 
+        tr = _tracing.get_tracer()
+        if tr.enabled:
+            # flight dump BEFORE reporting: the parent may reap siblings
+            # (and us) as soon as the failure lands on the queue
+            tr.dump_flight(f"fatal:{type(e).__name__}")
+            tr.write_buffer()
         q.put((r, False, f"{e}\n{traceback.format_exc()}"))
         return
     # report BEFORE the teardown barrier: if a sibling died, the barrier
@@ -1435,6 +1526,10 @@ def spawn(fn, nranks: int, job: Optional[str] = None, timeout: float = 120.0,
     # child terminated mid-teardown — e.g. under heavy machine load the
     # 10s join expired — must not leave /dev/shm litter behind
     shm_native.unlink_all(job, [])
+    if (failures or len(results) < nranks) and _tracing.tracing_dir():
+        # post-mortem: SIGKILLed ranks never ran their own dump — convert
+        # their mmap flight rings (page cache survives the process) to JSON
+        _tracing.convert_flight_rings(job)
     if failures:
         raise RuntimeError("island spawn failed:\n" + "\n".join(failures))
     # under allow_failures, killed ranks never reported: yield None

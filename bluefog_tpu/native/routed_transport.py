@@ -140,6 +140,22 @@ class RoutedWindow:
         else:
             self.tcp.write(dst, slot, array, p, accumulate)
 
+    def trace_stamp(self, dst: int, slot: int, word: int,
+                    writer: Optional[int] = None) -> None:
+        # must route exactly like the write it annotates, so the word
+        # lands beside the slot the consumer will actually read
+        w = self.rank if writer is None else writer
+        if self.shm is not None and self._same_host(w, dst):
+            self.shm.trace_stamp(self._shm_dst(dst), slot, word)
+        else:
+            self.tcp.trace_stamp(dst, slot, word)
+
+    def trace_peek(self, slot: int, src: Optional[int] = None) -> int:
+        if src is not None and self.shm is not None \
+                and self._same_host(self.rank, src):
+            return self.shm.trace_peek(slot)
+        return self.tcp.trace_peek(slot)
+
     def read(self, slot: int, collect: bool = False, src: Optional[int] = None):
         if src is not None and self.shm is not None \
                 and self._same_host(self.rank, src):

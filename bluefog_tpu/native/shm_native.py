@@ -314,6 +314,18 @@ class NativeShmWindow:
         if not self._h:
             raise RuntimeError(f"could not create shm window {self._name}")
         self._exposed_view: Optional[np.ndarray] = None
+        self._trace = _maybe_trace_sidecar(job, name, rank, nranks,
+                                           max(maxd, 1))
+
+    def trace_stamp(self, dst: int, slot: int, word: int,
+                    writer=None) -> None:
+        del writer  # single-transport: routing is the RoutedWindow's job
+        if self._trace is not None:
+            self._trace.stamp(dst, slot, word)
+
+    def trace_peek(self, slot: int, src=None) -> int:
+        del src
+        return self._trace.peek(slot) if self._trace is not None else 0
 
     def write(self, dst: int, slot: int, array, p: float = 1.0,
               accumulate: bool = False, writer=None,
@@ -552,6 +564,9 @@ class NativeShmWindow:
         if self._h:
             self._lib.bf_shm_win_destroy(self._h, 1 if unlink else 0)
             self._h = None
+        if self._trace is not None:
+            self._trace.close(unlink)
+            self._trace = None
 
     def unlink_segments(self) -> None:
         """Name-based unlink by the designated (segment-rank-0) rank —
@@ -761,6 +776,52 @@ class _FallbackSegment:
                     pass
 
 
+class TraceSidecar:
+    """One aligned u64 trace-context word per (dst, mailbox-slot) pair,
+    in an mmap segment that rides NEXT TO a window (``trace_<name>``)
+    rather than inside it — the native chunk-ring C struct is not
+    extensible without recompiling, and the fallback layout stays wire-
+    compatible.  Writes are single 8-byte aligned ``pack_into`` calls
+    (atomic in practice on x86/ARM64); the word is advisory — a torn or
+    stale read costs one flow arrow in the merged trace, never
+    correctness — so no locks are taken.  Created only when
+    ``BFTPU_TRACING`` is on; the ``seg_name`` prefix means
+    :func:`unlink_all` reclaims it with the window segments."""
+
+    def __init__(self, job: str, name: str, rank: int, nranks: int,
+                 maxd: int):
+        self.rank = int(rank)
+        self.maxd = int(maxd)
+        path = os.path.join(_FALLBACK_DIR, seg_name(job, f"trace_{name}")[1:])
+        self._seg = _FallbackSegment(path, nranks * self.maxd * 8)
+
+    def stamp(self, dst: int, slot: int, word: int) -> None:
+        struct.pack_into("<Q", self._seg._mm,
+                         (int(dst) * self.maxd + int(slot)) * 8,
+                         word & 0xFFFFFFFFFFFFFFFF)
+
+    def peek(self, slot: int) -> int:
+        return struct.unpack_from(
+            "<Q", self._seg._mm, (self.rank * self.maxd + int(slot)) * 8)[0]
+
+    def close(self, unlink: bool = False) -> None:
+        self._seg.close(unlink)
+
+
+def _maybe_trace_sidecar(job: str, name: str, rank: int, nranks: int,
+                         maxd: int):
+    """A window's trace sidecar when tracing is enabled, else None (the
+    window's trace_stamp/trace_peek become no-ops)."""
+    from bluefog_tpu.tracing.tracer import tracing_dir
+
+    if tracing_dir() is None:
+        return None
+    try:
+        return TraceSidecar(job, name, rank, nranks, maxd)
+    except OSError:
+        return None
+
+
 class FallbackShmJob:
     """Barrier + mutexes + heartbeats over lockf.  Layout:
     [arrived u64][generation u64], one lock byte per rank (the mutex is
@@ -881,6 +942,18 @@ class FallbackShmWindow:
         nslots = nranks + nranks * self.maxd
         path = os.path.join(_FALLBACK_DIR, seg_name(job, f"win_{name}")[1:])
         self._seg = _FallbackSegment(path, nslots * self._stride)
+        self._trace = _maybe_trace_sidecar(job, name, rank, nranks,
+                                           self.maxd)
+
+    def trace_stamp(self, dst: int, slot: int, word: int,
+                    writer=None) -> None:
+        del writer
+        if self._trace is not None:
+            self._trace.stamp(dst, slot, word)
+
+    def trace_peek(self, slot: int, src=None) -> int:
+        del src
+        return self._trace.peek(slot) if self._trace is not None else 0
 
     def _off(self, index: int) -> int:
         return index * self._stride
@@ -1093,6 +1166,9 @@ class FallbackShmWindow:
 
     def close(self, unlink: bool = False) -> None:
         self._seg.close(unlink)
+        if self._trace is not None:
+            self._trace.close(unlink)
+            self._trace = None
 
 
 # ---------------------------------------------------------------------------

@@ -22,9 +22,12 @@ Topology of responsibility (the passive-target model, unchanged):
   (``BLUEFOG_ISLAND_COORD``) must be known up front — the analogue of
   ``bfrun``'s host list [U].
 
-Wire format: 32-byte fixed header ``(op, win_id, slot, mode, nbytes, p)``
-+ raw payload bytes, over persistent connections (one per peer, created
-lazily).  No external dependencies.
+Wire format: 40-byte fixed header ``(op, win_id, slot, mode, nbytes, p,
+trace)`` + raw payload bytes, over persistent connections (one per peer,
+created lazily).  ``trace`` is the u64 trace-context word
+(:func:`bluefog_tpu.tracing.pack_ctx`; 0 = tracing off) that lets the
+merge CLI draw a flow arrow from the depositing span on the writer to
+the collecting span on the owner.  No external dependencies.
 """
 
 from __future__ import annotations
@@ -41,6 +44,7 @@ import numpy as np
 from bluefog_tpu.common.logging_util import logger
 from bluefog_tpu.resilience.detector import PeerTimeoutError
 from bluefog_tpu.telemetry import registry as _telemetry
+from bluefog_tpu.tracing import tracer as _tracing
 
 # ops
 _OP_WRITE = 1          # deposit into (my) mail slot: mode 0 put, 1 accumulate
@@ -53,6 +57,7 @@ _OP_PING = 7
 _OP_BARRIER_T = 8      # rank-0 only: timed barrier, timeout rides in p
 _OP_HEARTBEAT = 9      # rank-0 only: renew rank `slot`'s lease
 _OP_LIVENESS = 10      # rank-0 only: age of rank `slot`'s lease (in p)
+_OP_CLOCK = 11         # rank-0 only: coordinator's monotonic clock (in p)
 
 #: human-readable op names: PeerTimeoutError context + telemetry labels
 _OP_NAMES = {
@@ -60,10 +65,12 @@ _OP_NAMES = {
     _OP_MUTEX_ACQ: "mutex_acquire", _OP_MUTEX_REL: "mutex_release",
     _OP_BARRIER: "barrier", _OP_REGISTER: "register", _OP_PING: "ping",
     _OP_BARRIER_T: "barrier_timed", _OP_HEARTBEAT: "heartbeat",
-    _OP_LIVENESS: "liveness",
+    _OP_LIVENESS: "liveness", _OP_CLOCK: "clock",
 }
 
-_HDR = struct.Struct("<iiiiqd")  # op, win_id, slot, mode, nbytes, p
+# op, win_id, slot, mode, nbytes, p, trace — the trace word is LAST so
+# pre-trace header fields keep their offsets on the wire
+_HDR = struct.Struct("<iiiiqdQ")
 
 
 def peer_timeout_s() -> Optional[float]:
@@ -93,8 +100,9 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return buf  # bytearray: frombuffer/slice-assign/decode all accept it
 
 
-def _send_msg(sock, op, win_id=0, slot=0, mode=0, p=0.0, payload=b""):
-    hdr = _HDR.pack(op, win_id, slot, mode, len(payload), p)
+def _send_msg(sock, op, win_id=0, slot=0, mode=0, p=0.0, payload=b"",
+              trace=0):
+    hdr = _HDR.pack(op, win_id, slot, mode, len(payload), p, trace)
     if not payload:
         sock.sendall(hdr)
         return
@@ -110,18 +118,22 @@ def _send_msg(sock, op, win_id=0, slot=0, mode=0, p=0.0, payload=b""):
 
 
 def _recv_msg(sock):
-    op, win_id, slot, mode, nbytes, p = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    # trace rides LAST in the tuple so existing payload/mode indexing
+    # ([5], [3], ...) is unchanged
+    op, win_id, slot, mode, nbytes, p, trace = _HDR.unpack(
+        _recv_exact(sock, _HDR.size))
     payload = _recv_exact(sock, nbytes) if nbytes else b""
-    return op, win_id, slot, mode, p, payload
+    return op, win_id, slot, mode, p, payload, trace
 
 
 class _Slot:
-    __slots__ = ("data", "p", "version")
+    __slots__ = ("data", "p", "version", "trace")
 
     def __init__(self, nbytes: int):
         self.data = bytearray(nbytes)
         self.p = 0.0
         self.version = 0
+        self.trace = 0  # trace-context word of the last deposit
 
 
 class _WinStore:
@@ -186,7 +198,7 @@ class _Server:
     def _serve_conn(self, conn):
         try:
             while True:
-                op, win_id, slot, mode, p, payload = _recv_msg(conn)
+                op, win_id, slot, mode, p, payload, trace = _recv_msg(conn)
                 if op == _OP_WRITE:
                     with self.lock:
                         w = self.windows[win_id]
@@ -212,6 +224,8 @@ class _Server:
                             s.data[:] = payload
                             s.p = p
                         s.version += 1
+                        if trace:
+                            s.trace = trace
                     _send_msg(conn, op)  # ack → MPI_Win_flush semantics
                 elif op == _OP_READ_EXPOSED:
                     with self.lock:
@@ -291,6 +305,12 @@ class _Server:
                         stamp = self.leases.get(slot, 0.0)
                     age = (time.monotonic() - stamp) if stamp > 0 else -1.0
                     _send_msg(conn, op, p=age)
+                elif op == _OP_CLOCK:
+                    # coordinator clock read for the min-RTT offset
+                    # estimator (bluefog_tpu.tracing.clock): reply as
+                    # late as possible so queueing before the read only
+                    # widens the client's RTT bound, never biases it
+                    _send_msg(conn, op, p=time.monotonic())
                 elif op == _OP_PING:
                     _send_msg(conn, op)
                 else:
@@ -324,7 +344,7 @@ class _Peers:
         self.locks: Dict[int, threading.Lock] = {}
 
     def request(self, rank: int, op, win_id=0, slot=0, mode=0, p=0.0,
-                payload=b""):
+                payload=b"", trace=0):
         reg = _telemetry.get_registry()
         opname = _OP_NAMES.get(op, str(op))
         t0 = time.perf_counter_ns() if reg.enabled else 0
@@ -341,7 +361,8 @@ class _Peers:
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 self.conns[rank] = conn
             try:
-                _send_msg(conn, op, win_id, slot, mode, p, payload)
+                _send_msg(conn, op, win_id, slot, mode, p, payload,
+                          trace=trace)
                 reply = _recv_msg(conn)
             except socket.timeout as e:
                 # half-done exchange: the stream is unusable (a late reply
@@ -356,6 +377,10 @@ class _Peers:
                     reg.counter("tcp.timeouts", op=opname).inc()
                     reg.journal("peer_timeout", peer_rank=rank, addr=addr,
                                 op=opname, deadline_s=peer_timeout_s())
+                tr = _tracing.get_tracer()
+                if tr.enabled:
+                    tr.instant(f"peer_timeout:{opname}", aux=rank)
+                    tr.dump_flight(f"PeerTimeoutError:{opname}:r{rank}")
                 raise PeerTimeoutError(
                     f"rank {rank} ({addr}) did not respond to op "
                     f"{opname} within {peer_timeout_s()}s (set "
@@ -429,7 +454,7 @@ class _JobRuntime:
         coord_conn.settimeout(peer_timeout_s())
         coord_conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         _send_msg(coord_conn, _OP_REGISTER, slot=rank, payload=my_addr.encode())
-        _, _, _, _, _, table_raw = _recv_msg(coord_conn)
+        table_raw = _recv_msg(coord_conn)[5]
         self._coord_conn = coord_conn  # kept open: barrier rides on it
         self._coord_addr = (chost, int(cport))
         # leases ride a SEPARATE lazily-created coordinator connection: the
@@ -497,7 +522,7 @@ class _JobRuntime:
                     try:
                         _send_msg(self._coord_conn, _OP_BARRIER_T,
                                   p=float(timeout))
-                        _, _, _, mode, _, _ = _recv_msg(self._coord_conn)
+                        mode = _recv_msg(self._coord_conn)[3]
                     finally:
                         self._coord_conn.settimeout(old)
             except socket.timeout as e:
@@ -509,6 +534,10 @@ class _JobRuntime:
                     reg.counter("tcp.timeouts", op="barrier").inc()
                     reg.journal("peer_timeout", peer_rank=0, addr=addr,
                                 op="barrier")
+                tr = _tracing.get_tracer()
+                if tr.enabled:
+                    tr.instant("peer_timeout:barrier", aux=0)
+                    tr.dump_flight("PeerTimeoutError:barrier")
                 raise PeerTimeoutError(
                     "coordinator (rank 0) did not answer the barrier "
                     f"within its deadline ({addr})",
@@ -529,8 +558,7 @@ class _JobRuntime:
                 self._lease_conn = conn
             try:
                 _send_msg(conn, op, slot=rank)
-                _, _, _, _, age, _ = _recv_msg(conn)
-                return age
+                return _recv_msg(conn)[4]
             except (socket.timeout, ConnectionError, OSError):
                 self._lease_conn = None
                 try:
@@ -571,6 +599,16 @@ class TcpShmJob:
             return 0.0
         return max(0.0, time.monotonic() - age)
 
+    def clock_probe(self) -> Tuple[float, float, float]:
+        """One NTP-style exchange with the rank-0 coordinator: returns
+        ``(t0, remote, t1)`` — local send time, the coordinator's
+        monotonic clock, local receive time — for
+        :class:`bluefog_tpu.tracing.ClockEstimator`.  Rides the lease
+        connection, which works while a barrier blocks the main one."""
+        t0 = time.monotonic()
+        remote = self.rt._lease_request(_OP_CLOCK, self.rank)
+        return t0, remote, time.monotonic()
+
     def close(self, unlink: bool = False) -> None:
         del unlink
         _JobRuntime.drop(self.job, self.rank)
@@ -590,10 +628,25 @@ class TcpShmWindow:
             self.rt.server.windows[self._id] = _WinStore(
                 maxd, self.nbytes, self.dtype
             )
+        # trace words staged by trace_stamp, consumed (popped) by the
+        # immediately-following write() — same-thread call pattern
+        self._trace_out: Dict[Tuple[int, int], int] = {}
 
     # -- local (owner-side) ops --------------------------------------------
     def _store(self) -> _WinStore:
         return self.rt.server.windows[self._id]
+
+    def trace_stamp(self, dst: int, slot: int, word: int,
+                    writer=None) -> None:
+        """Stage the trace-context word for the next write to (dst,
+        slot); it rides the frame header of that write."""
+        del writer
+        self._trace_out[(int(dst), int(slot))] = int(word)
+
+    def trace_peek(self, slot: int, src=None) -> int:
+        del src
+        with self.rt.server.lock:
+            return self._store().mail[slot].trace
 
     def read(self, slot: int, collect: bool = False, src=None):
         del src
@@ -643,6 +696,7 @@ class TcpShmWindow:
                 f"win_put payload has {a.nbytes} bytes but window "
                 f"expects {self.nbytes} (shape {self.shape})"
             )
+        trace = self._trace_out.pop((int(dst), int(slot)), 0)
         if dst == self.rt.rank:
             # local fast path, same semantics
             with self.rt.server.lock:
@@ -655,6 +709,8 @@ class TcpShmWindow:
                     s.data[:] = a.tobytes()
                     s.p = float(p)
                 s.version += 1
+                if trace:
+                    s.trace = trace
             return
         try:
             # zero-copy byte view; the uint8 reinterpret also covers
@@ -664,7 +720,7 @@ class TcpShmWindow:
             payload = a.tobytes()
         self.rt.peers.request(
             dst, _OP_WRITE, self._id, slot, 1 if accumulate else 0,
-            float(p), payload,
+            float(p), payload, trace=trace,
         )
 
     def read_exposed(self, src: int):
@@ -673,7 +729,7 @@ class TcpShmWindow:
                 s = self._store().exposed
                 a = np.frombuffer(bytes(s.data), self.dtype).reshape(self.shape)
                 return a.copy(), s.p, s.version
-        _, _, ver, _, p, payload = self.rt.peers.request(
+        _, _, ver, _, p, payload, _ = self.rt.peers.request(
             src, _OP_READ_EXPOSED, self._id
         )
         a = np.frombuffer(payload, self.dtype).reshape(self.shape)

@@ -35,6 +35,7 @@ import time
 from typing import Optional, Set
 
 from bluefog_tpu.telemetry import registry as _telemetry
+from bluefog_tpu.tracing import tracer as _tracing
 
 __all__ = [
     "PeerTimeoutError",
@@ -112,6 +113,11 @@ class FailureDetector:
             reg = _telemetry.get_registry()
             if reg.enabled:
                 reg.counter("resilience.heartbeats_sent").inc()
+        tr = _tracing.get_tracer()
+        if tr.enabled:
+            # ride the heartbeat cadence: one clock probe per beat keeps
+            # the min-RTT offset estimator fresh without a second timer
+            tr.resample_clock(self._job)
 
     def start(self) -> "FailureDetector":
         if self._thread is None and self._supported:

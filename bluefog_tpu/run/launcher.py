@@ -28,6 +28,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import shlex
 import signal
@@ -475,6 +476,46 @@ def _collect_telemetry(env: dict, job: str) -> None:
         print(f"bftpu-run: telemetry merge failed: {e}", file=sys.stderr)
 
 
+def _collect_traces(env: dict, job: str) -> None:
+    """Best-effort trace post-processing: convert flight rings left by
+    ranks that died without dumping (SIGKILL), then stitch the per-rank
+    span buffers into one merged Chrome trace.  No-op when BFTPU_TRACING
+    is off; never fails the run."""
+    raw = env.get("BFTPU_TRACING", "")
+    if not raw or raw == "0":
+        return
+    try:
+        from bluefog_tpu import tracing as _tracing
+        from bluefog_tpu.tracing.tracer import _DEFAULT_DIR
+
+        d = _DEFAULT_DIR if raw == "1" else raw
+        if not d or not os.path.isdir(d):
+            return
+        converted = _tracing.convert_flight_rings(job, d, reason="launcher")
+        for p in converted:
+            print(f"bftpu-run: flight ring recovered -> {p}",
+                  file=sys.stderr)
+        traces = []
+        for p in _tracing.find_traces([d]):
+            try:
+                t = _tracing.load_trace(p)
+            except (OSError, ValueError):
+                continue
+            if t is not None and t.get("job") == job:
+                traces.append(t)
+        if not traces:
+            return
+        merged = _tracing.merge_traces(traces)
+        out = os.path.join(d, f"merged-trace-{job}.json")
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(merged, f)
+            f.write("\n")
+        print(f"bftpu-run: traces merged ({len(traces)} ranks) -> {out}",
+              file=sys.stderr)
+    except Exception as e:  # tracing must never mask the run's exit code
+        print(f"bftpu-run: trace merge failed: {e}", file=sys.stderr)
+
+
 def _run_islands(cmd, env, nranks: int, job, hosts, timeout: float) -> int:
     """Fork N island processes (the `mpirun -np N` shape of the reference's
     launcher [U]).  With ``-H``, ranks spawn on their hosts over ssh and
@@ -512,6 +553,7 @@ def _run_islands(cmd, env, nranks: int, job, hosts, timeout: float) -> int:
         finally:
             _cleanup_island_segments(job, by_rank)
             _collect_telemetry(env, job)
+            _collect_traces(env, job)
         if (code not in (0, 124, 130) and multi_host and attempt == 0
                 and time.monotonic() - t0 < 20.0):
             # same fast-failure signature as _run_multiprocess: the TCP

@@ -22,6 +22,7 @@ import atexit
 import contextlib
 import json
 import os
+import signal
 import threading
 import time
 from typing import Optional
@@ -59,6 +60,36 @@ class TimelineWriter:
         except Exception:  # pragma: no cover - native lib optional
             self._native = None
         atexit.register(self.flush)
+        self._install_sigterm()
+
+    def _install_sigterm(self) -> None:
+        # atexit never runs under SIGTERM's default disposition, and
+        # launchers kill islands with SIGTERM — flush the buffer first,
+        # then chain to whatever handler was installed before us
+        try:
+            prev = signal.getsignal(signal.SIGTERM)
+        except (ValueError, TypeError):  # pragma: no cover - odd runtimes
+            return
+
+        def _on_term(signum, frame):
+            try:
+                self.flush()
+            except Exception:  # noqa: BLE001 - dying anyway
+                pass
+            if callable(prev):
+                prev(signum, frame)
+            else:
+                try:
+                    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                except (ValueError, TypeError):
+                    pass
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        try:
+            signal.signal(signal.SIGTERM, _on_term)
+        except (ValueError, TypeError):
+            # non-main thread: atexit still covers graceful exits
+            pass
 
     def _now_us(self) -> float:
         return (time.perf_counter_ns() - self._t0) / 1e3
