@@ -484,6 +484,17 @@ def main():
             print(json.dumps(rec), file=sys.stderr)
         except Exception as e:  # noqa: BLE001
             print(f"recovery phase failed: {e!r}", file=sys.stderr)
+    jn = None
+    if time.perf_counter() - t_start < budget_s:
+        try:
+            # elastic-membership headline (docs/RESILIENCE.md "Elastic
+            # membership"): scale 4 gossiping island ranks to 5; the
+            # joiner's rendezvous-to-first-grown-gossip-round latency
+            from recovery import measure_join
+            jn = measure_join(nprocs=4)
+            print(json.dumps(jn), file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"join phase failed: {e!r}", file=sys.stderr)
 
     headline = {
         "metric": "ResNet-50 images/sec/chip (neighbor_allreduce exp2)"
@@ -551,6 +562,15 @@ def main():
         # the detector floor: recovery_ms minus this is drain + replan +
         # one degraded gossip round
         headline["recovery_failure_timeout_ms"] = rec["failure_timeout_ms"]
+    if jn is not None:
+        headline["join_ms"] = jn["value"]
+        headline["join_metric"] = jn["metric"]
+        # the admission floor (the analogue of the detector floor):
+        # members probe the board once per gossip round, so join_ms
+        # minus one round period is grant + epoch switch + state
+        # transfer + the first grown round
+        headline["join_member_switch_range_ms"] = \
+            jn["member_switch_range_ms"]
     print(json.dumps(headline))
 
 

@@ -105,7 +105,107 @@ def measure_recovery(nprocs: int = 4, victim: int = 1,
     }
 
 
+def _elastic_worker(rank, size, job, q):
+    from bluefog_tpu import islands, topology_util
+
+    islands.init(rank, size, job)
+    islands.set_topology(topology_util.ExponentialTwoGraph(size))
+    islands.win_create(np.full(4, float(rank), np.float64), "rec")
+    islands.barrier()
+    q.put(("up", rank, os.getpid(), time.monotonic()))
+    deadline = time.monotonic() + 60.0
+    rec = None
+    while time.monotonic() < deadline and rec is None:
+        islands.win_put(islands.win_sync("rec"), "rec")
+        islands.win_update("rec")
+        # the admission probe rides the gossip cadence: one cheap
+        # epoch-word stat per round until a joiner shows up
+        rec = islands.admit_pending(timeout=30)
+    if rec is not None:
+        # first full gossip round on the grown membership
+        islands.win_put(islands.win_sync("rec"), "rec")
+        islands.win_update("rec")
+        islands.barrier()
+        q.put(("grown", islands.global_rank(), islands.size(),
+               time.monotonic()))
+        islands.barrier()
+    islands.shutdown(unlink=False)
+
+
+def _join_worker(job, q):
+    from bluefog_tpu import islands
+
+    q.put(("posted", -1, os.getpid(), time.monotonic()))
+    islands.join(job=job, timeout=60)
+    islands.win_put(islands.win_sync("rec"), "rec")
+    islands.win_update("rec")
+    islands.barrier()
+    q.put(("joined", islands.global_rank(), islands.size(),
+           time.monotonic()))
+    islands.barrier()
+    islands.shutdown(unlink=False)
+
+
+def measure_join(nprocs: int = 4) -> dict:
+    """Scale ``nprocs`` gossiping island ranks to ``nprocs + 1``: return
+    the metric dict with ``value`` = rendezvous-to-first-gossip-round
+    latency of the joiner in ms (bench.py's ``join_ms`` headline).  Like
+    ``recovery_ms`` is dominated by the detector floor, this is
+    dominated by the members' admission cadence (they probe the board
+    once per gossip round) — the interesting part is the margin above
+    it: grant + epoch switch + state transfer + one round."""
+    import multiprocessing as mp
+
+    from bluefog_tpu.native import shm_native
+
+    job = f"join{os.getpid()}"
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_elastic_worker, args=(r, nprocs, job, q))
+             for r in range(nprocs)]
+    joiner = ctx.Process(target=_join_worker, args=(job, q))
+    try:
+        for p in procs:
+            p.start()
+        for _ in range(nprocs):
+            tag, r, pid, _t = q.get(timeout=300)
+            assert tag == "up"
+        time.sleep(0.3)  # steady-state gossip before the scale-out
+        joiner.start()
+        t_post = None
+        t_joined = None
+        member_ms = []
+        while t_joined is None or len(member_ms) < nprocs:
+            tag, r, extra, t = q.get(timeout=90)
+            if tag == "posted":
+                t_post = t
+            elif tag == "joined":
+                assert extra == nprocs + 1, (tag, r, extra)
+                t_joined = t
+            elif tag == "grown":
+                assert extra == nprocs + 1, (tag, r, extra)
+                member_ms.append(t)
+        join_ms = (t_joined - t_post) * 1000.0
+        member_lat = sorted((t - t_post) * 1000.0 for t in member_ms)
+    finally:
+        for p in procs + [joiner]:
+            p.join(timeout=15)
+            if p.is_alive():
+                p.terminate()
+        shm_native.unlink_all(job, ["rec"])
+    return {
+        "metric": f"join rendezvous to first gossip round including the "
+                  f"new rank (exp2, {nprocs}+1 procs, shm mailbox)",
+        "value": round(join_ms, 1),
+        "unit": "ms",
+        "member_switch_range_ms": [round(member_lat[0], 1),
+                                   round(member_lat[-1], 1)],
+        "members": nprocs,
+    }
+
+
 if __name__ == "__main__":
     import json
 
-    print(json.dumps(measure_recovery()))
+    print(json.dumps({"recovery": measure_recovery(),
+                      "join": measure_join()}))

@@ -173,6 +173,55 @@ def _healed_not_doubly_stochastic() -> List[Finding]:
         mutated, "exp2@8-dead[3][skipped-mh]", expect_column=True)
 
 
+def _grown_reuses_dead_rank() -> List[Finding]:
+    """A grown membership view that handed a joiner the CORPSE's global
+    rank: stale deposits addressed to the dead rank would be consumed by
+    the new member — double-counted mass."""
+    from bluefog_tpu.resilience.healing import grow_topology
+    import networkx as nx
+
+    healed = heal_topology(tu.ExponentialTwoGraph(8), dead=[3])
+    G = nx.relabel_nodes(healed.topology,
+                         dict(enumerate(healed.to_global)), copy=True)
+    grown = grow_topology(G, [8])
+    # lie: the view claims rank 8 (a mapped member) is ALSO dead — the
+    # reissued-corpse signature check_grown exists to catch
+    lied = dataclasses.replace(grown, dead=(8,))
+    return resilience_rules.check_grown(
+        lied, "exp2@8[joiner-reuses-corpse]").findings
+
+
+def _grown_not_doubly_stochastic() -> List[Finding]:
+    """A grown plan whose Metropolis–Hastings re-weighting skipped one
+    spliced-in edge (weight doubled): the grown W stops being doubly
+    stochastic, so post-admission gossip drifts off the consensus the
+    joiner was onboarded at."""
+    from bluefog_tpu.resilience.healing import grow_topology
+
+    grown = grow_topology(tu.ExponentialTwoGraph(8), [8, 9])
+    cls = grown.plan.classes[0]
+    rw = list(cls.recv_weights)
+    idx = next(i for i, w in enumerate(rw) if w != 0.0)
+    rw[idx] *= 2.0
+    bad = dataclasses.replace(cls, recv_weights=tuple(rw))
+    mutated = dataclasses.replace(grown.plan,
+                                  classes=(bad,) + grown.plan.classes[1:])
+    return plan_rules.check_mixing_stochastic(
+        mutated, "exp2@8+join[8,9][skipped-mh]", expect_column=True)
+
+
+def _epoch_switch_unbalanced_ledger() -> List[Finding]:
+    """An epoch_switch journal where one member's switch-point counters
+    lost a deposit (retired neither collected, drained, nor pending):
+    mass crossed the membership barrier unaccounted."""
+    events = resilience_rules._synthetic_epoch_journal()
+    ev = next(e for e in events if e["new_epoch"] == 1
+              and e["old_epoch"] is not None)
+    ev["pending"] -= 2  # two deposits vanish at the cut
+    return resilience_rules.check_membership_epochs(
+        events, "fixture[unbalanced-switch]")
+
+
 # ---------------------------------------------------------------------------
 # protocol fixtures: broken seqlock/collect/barrier variants + bad traces
 # ---------------------------------------------------------------------------
@@ -324,6 +373,9 @@ FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
     # resilience family: botched healings + broken dead-writer drains
     "healed-dead-rank-not-excised": _healed_dead_not_excised,
     "healed-not-doubly-stochastic": _healed_not_doubly_stochastic,
+    "grown-reuses-dead-rank": _grown_reuses_dead_rank,
+    "grown-not-doubly-stochastic": _grown_not_doubly_stochastic,
+    "epoch-switch-unbalanced-ledger": _epoch_switch_unbalanced_ledger,
     "dead-writer-lost-mass-drain": lambda: _model_fixture(
         seqlock_model.dead_writer_drain_model(deposits=2,
                                               account_wiped=False)),

@@ -47,6 +47,7 @@ from bluefog_tpu import topology_util
 from bluefog_tpu.native import shm_native
 from bluefog_tpu.resilience import degraded as _degraded
 from bluefog_tpu.resilience import healing as _healing
+from bluefog_tpu.resilience import join as _join
 from bluefog_tpu.resilience.detector import FailureDetector
 from bluefog_tpu.telemetry import registry as _telemetry
 from bluefog_tpu.timeline import timeline_context
@@ -84,6 +85,11 @@ __all__ = [
     "dead_ranks",
     "heal",
     "resilience_detector",
+    "global_rank",
+    "members",
+    "membership_epoch",
+    "join",
+    "admit_pending",
     "spawn",
 ]
 
@@ -113,6 +119,12 @@ class _IslandWindow:
         # the word unchanged consumed no NEW deposit on that edge, so no
         # duplicate flow arrow is recorded
         self._trace_seen: Dict[int, int] = {}
+        # writer-side deposit tally per destination, and the version the
+        # creation seed left in each slot: together they let heal()
+        # settle the ledger for a dead peer (adopt its lost writer-side
+        # counts, write off deposits it will never combine)
+        self._deposited_to: Dict[int, int] = {}
+        self._seed_ver = 0 if zero_init else 1
         self.shm = shm_native.make_window(
             ctx.job, name, ctx.rank, ctx.size, maxd,
             tensor.shape, tensor.dtype,
@@ -158,6 +170,14 @@ class _IslandContext:
         self.detector = FailureDetector(self.shm_job, rank_, size_).start()
         self.dead: set = set()
         self.healed: Optional[_healing.HealedTopology] = None
+        # elastic membership (resilience/join.py): epoch 0 is the launch
+        # view, where local and global ranks coincide.  After an epoch
+        # switch ``rank``/``size``/``job`` describe the CURRENT epoch's
+        # dense world while these fields keep the stable identity.
+        self.base_job = job
+        self.epoch = 0
+        self.global_rank = rank_
+        self.members_global: Tuple[int, ...] = tuple(range(size_))
 
 
 def _trivial_graph() -> nx.DiGraph:
@@ -183,6 +203,13 @@ def init(rank_: Optional[int] = None, size_: Optional[int] = None,
     ``bfrun`` reading MPI env [U]."""
     global _context
     if _context is not None:
+        return
+    if rank_ is None and os.environ.get("BLUEFOG_ISLAND_JOINER") == "1":
+        # a launcher-spawned replacement/scale-out process (bftpu-run
+        # --self-heal / --attach scale): rendezvous as a JOINER instead
+        # of binding a launch rank — the script's init() call needs no
+        # changes to run elastically
+        join(job=job)
         return
     if rank_ is None or size_ is None:
         if "BLUEFOG_ISLAND_RANK" not in os.environ:
@@ -210,6 +237,12 @@ def init(rank_: Optional[int] = None, size_: Optional[int] = None,
         tr.set_identity(r, n, j)
         tr.instant("island_init")
     _context = _IslandContext(r, n, j)
+    try:
+        # publish the elastic-membership board (idempotent, first writer
+        # wins) so a later joiner can rendezvous; see resilience/join.py
+        _join.MembershipBoard(j).ensure(n)
+    except OSError:
+        pass  # read-only shm dir: the job simply is not elastic
     _context.shm_job.barrier()
 
 
@@ -351,7 +384,29 @@ def heal(dead=None):
         breaker = getattr(ctx.shm_job, "mutex_break", None)
         if breaker is not None:
             breaker(r)
+    adopted = written_off = 0
     for win in ctx.windows.values():
+        if reg.enabled:
+            # the corpse's registry died with it, so BOTH sides of its
+            # edges must be settled from the survivor side or the global
+            # conservation identity (deposits == collected + drained +
+            # pending over the live registries) breaks:
+            # - edges corpse->me: ADOPT its lost writer-side count — the
+            #   slot version is the monotone deposit count, minus the
+            #   creation seed;
+            # - edges me->corpse: WRITE OFF my deposits it will never
+            #   combine — they leave live circulation as pending.
+            rv = getattr(win.shm, "read_version", None)
+            for s in win.in_neighbors:
+                if s in new and rv is not None:
+                    try:
+                        v = int(rv(win.slot_of[ctx.rank][s], src=s))
+                    except Exception:  # noqa: BLE001 - accounting only
+                        v = win._seed_ver
+                    if v > win._seed_ver:
+                        adopted += v - win._seed_ver
+            for r in new:
+                written_off += win._deposited_to.pop(r, 0)
         drain = getattr(win.shm, "force_drain", None)
         if drain is None:
             continue
@@ -362,6 +417,11 @@ def heal(dead=None):
                     _ledger_retire_probe(
                         reg, win, slot, s, _telemetry.LEDGER_DRAINED)
                 drain(slot, src=s)
+    if reg.enabled:
+        if adopted:
+            reg.counter(_telemetry.LEDGER_DEPOSITS).add(adopted)
+        if written_off:
+            reg.counter(_telemetry.LEDGER_PENDING).add(written_off)
     ctx.healed = _healing.heal_topology(ctx.topology, sorted(ctx.dead))
     tr = _tracing.get_tracer()
     if tr.enabled and new:
@@ -372,8 +432,295 @@ def heal(dead=None):
         reg.counter("resilience.heals").inc()
         reg.histogram("resilience.heal_s").observe(dt)
         reg.journal("heal", new_dead=sorted(new), dead=sorted(ctx.dead),
-                    duration_s=dt)
+                    duration_s=dt, ledger_adopted=adopted,
+                    ledger_written_off=written_off)
     return ctx.healed
+
+
+# ---------------------------------------------------------------------------
+# elastic membership: rank join + epoch switch (resilience/join.py;
+# docs/RESILIENCE.md "Elastic membership")
+# ---------------------------------------------------------------------------
+
+
+def global_rank() -> int:
+    """This rank's stable global identity.  Equal to :func:`rank` in the
+    launch epoch; after membership changes :func:`rank` is the dense
+    epoch-local rank while the global rank never changes (and a dead
+    rank's global id is never reissued)."""
+    return _ctx().global_rank
+
+
+def members() -> Tuple[int, ...]:
+    """Sorted global ranks of the current membership epoch."""
+    return tuple(_ctx().members_global)
+
+
+def membership_epoch() -> int:
+    """The membership epoch this rank is currently participating in."""
+    return _ctx().epoch
+
+
+def _ledger_totals(reg) -> Dict[str, float]:
+    return {
+        "deposits": reg.counter(_telemetry.LEDGER_DEPOSITS).value,
+        "collected": reg.counter(_telemetry.LEDGER_COLLECTED).value,
+        "drained": reg.counter(_telemetry.LEDGER_DRAINED).value,
+        "pending": reg.counter(_telemetry.LEDGER_PENDING).value,
+    }
+
+
+def _live_global_graph(ctx: "_IslandContext") -> nx.DiGraph:
+    """The current topology restricted to live members, in GLOBAL rank
+    labels — the graph :func:`grow_topology` splices joiners into."""
+    mapping = {l: ctx.members_global[l] for l in range(ctx.size)
+               if l not in ctx.dead}
+    G = nx.DiGraph()
+    G.add_nodes_from(sorted(mapping.values()))
+    for u, v in ctx.topology.edges:
+        if u != v and u in mapping and v in mapping:
+            G.add_edge(mapping[u], mapping[v])
+    return G
+
+
+def _windows_meta(ctx: "_IslandContext") -> List[dict]:
+    return [{"name": n,
+             "shape": [int(d) for d in ctx.windows[n].shm.shape],
+             "dtype": str(np.dtype(ctx.windows[n].shm.dtype))}
+            for n in sorted(ctx.windows)]
+
+
+def _switch_epoch(ctx: "_IslandContext", rec: dict) -> None:
+    """Member side of the epoch switch: retire outstanding mailbox mass,
+    journal the ledger balance AT the switch (the membership-epoch
+    audit point), close the old epoch's segments, and rebind into the
+    epoch-suffixed namespace with the committed topology and windows.
+
+    Old-epoch segments are left for crashed-run hygiene to reclaim (the
+    designated unlink rank of the old epoch may be exactly the corpse
+    being replaced); ``unlink_all``'s job-prefix glob catches every
+    epoch's segments.
+    """
+    reg = _telemetry.get_registry()
+    tr = _tracing.get_tracer()
+    t0 = time.perf_counter_ns()
+    saved: Dict[str, Tuple[np.ndarray, float]] = {}
+    for name, w in ctx.windows.items():
+        if reg.enabled:
+            # deposits still sitting in slots cross the epoch boundary as
+            # "pending" — never silently: the conservation identity
+            # deposits == collected + drained + pending must hold AT the
+            # switch (the resilience.membership-epoch rule checks it)
+            _ledger_probe_pending(reg, w, ctx.rank)
+        saved[name] = (np.array(w.self_tensor, copy=True), float(w.p_self))
+    if reg.enabled:
+        reg.journal("epoch_switch", old_epoch=ctx.epoch,
+                    new_epoch=int(rec["epoch"]),
+                    global_rank=ctx.global_rank,
+                    joined=list(rec.get("joined", ())),
+                    **_ledger_totals(reg))
+    ctx.detector.stop()
+    for w in ctx.windows.values():
+        w.shm.close(unlink=False)
+    ctx.shm_job.close(unlink=False)
+
+    new_members = tuple(int(m) for m in rec["members"])
+    new_local = new_members.index(ctx.global_rank)
+    m = len(new_members)
+    ejob = _join.epoch_job(ctx.base_job, int(rec["epoch"]))
+    ctx.rank = new_local
+    ctx.size = m
+    ctx.job = ejob
+    ctx.epoch = int(rec["epoch"])
+    ctx.members_global = new_members
+    ctx.topology = _join.record_graph(rec)
+    ctx.dead = set()
+    ctx.healed = None
+    ctx.windows = {}
+    ctx.created_names = set()
+    ctx.shm_job = shm_native.make_job(ejob, new_local, m)
+    ctx.detector = FailureDetector(ctx.shm_job, new_local, m).start()
+    ctx.shm_job.barrier()  # every new-epoch member (joiners included)
+    for wmeta in sorted(rec["windows"], key=lambda w: w["name"]):
+        name = wmeta["name"]
+        t, p = saved[name]
+        win = _IslandWindow(name, t, ctx, zero_init=True)
+        ctx.windows[name] = win
+        ctx.created_names.add(name)
+        if p != 1.0:
+            # carry this member's push-sum mass across the epoch: the
+            # fresh window exposed (t, 1.0); restore the true (t, p)
+            win.p_self = p
+            win.shm.expose(win.self_tensor, p)
+        # re-seed my own slots with the restored (t, p) — the creation
+        # contract (pre-put win_update is a no-op average); zero slots
+        # would bleed into the first post-switch combines and destroy
+        # the consensus value admission is supposed to preserve
+        for k, s in enumerate(win.in_neighbors):
+            win.shm.write(ctx.rank, k, win.self_tensor,
+                          p=win.p_self, writer=s)
+            win._ledger_seen[k] = 1
+        win._seed_ver = 1
+    ctx.shm_job.barrier()  # every (t, p) exposure restored — joiners
+    ctx.shm_job.barrier()  # ... finished their onboarding reads
+    if tr.enabled:
+        tr.instant("epoch_switch", aux=ctx.epoch)
+    if reg.enabled:
+        reg.counter("resilience.epoch_switches").inc()
+        reg.histogram("resilience.epoch_switch_s").observe(
+            (time.perf_counter_ns() - t0) / 1e9)
+
+
+def admit_pending(timeout: Optional[float] = None):
+    """Admit any pending join requests and switch the job to the next
+    membership epoch.  Call at a round barrier on EVERY member (the
+    natural spot is right after a combine); returns the committed epoch
+    record, or None when nobody is waiting to join.
+
+    The sponsor — the lowest live global rank — grants all pending
+    requests in one atomic board commit (fresh ranks, grown topology,
+    window metadata); every other member waits for the commit, then all
+    members switch together (see :func:`_switch_epoch`).  If the
+    sponsor dies mid-admission, the next-lowest live rank takes over —
+    the board commit is idempotent, so a raced double-grant resolves to
+    the first record.
+    """
+    ctx = _ctx()
+    board = _join.MembershipBoard(ctx.base_job)
+    rec = None
+    if shm_native.membership_epoch(ctx.base_job) > ctx.epoch:
+        rec = board.epoch_record(ctx.epoch + 1)
+    if rec is None:
+        if not board.pending_requests():
+            return None
+        if ctx.detector.dead_ranks() - ctx.dead:
+            heal()  # the grown view must not include a corpse
+        reg = _telemetry.get_registry()
+        if reg.enabled:
+            reg.journal("join_requested_seen", epoch=ctx.epoch)
+        deadline = time.monotonic() + (
+            _degraded.op_deadline_s() if timeout is None else timeout)
+        while rec is None:
+            live = [ctx.members_global[l] for l in range(ctx.size)
+                    if l not in ctx.dead]
+            if ctx.global_rank == min(live) and board.pending_requests():
+                rec = board.grant(
+                    ctx.global_rank, live, _live_global_graph(ctx),
+                    _windows_meta(ctx), ctx.associated_p, ctx.epoch)
+                if rec is not None and reg.enabled:
+                    reg.counter("resilience.joins_admitted").inc(
+                        len(rec["joined"]))
+                    reg.journal("join_admitted",
+                                joined=list(rec["joined"]),
+                                epoch=int(rec["epoch"]),
+                                sponsor=ctx.global_rank)
+                break
+            rec = board.epoch_record(ctx.epoch + 1)
+            if rec is not None:
+                break
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"epoch {ctx.epoch + 1} not committed within the "
+                    "deadline (is the sponsor calling admit_pending?)")
+            # the sponsor may itself be the next corpse: refresh the
+            # verdict so sponsorship falls through to the next-lowest
+            if ctx.detector.dead_ranks() - ctx.dead:
+                heal()
+            time.sleep(_join.join_poll_s())
+    if rec is None:
+        return None
+    _switch_epoch(ctx, rec)
+    return dict(rec)
+
+
+def join(job: Optional[str] = None, timeout: Optional[float] = None):
+    """Join a LIVE island job as a brand-new rank (the elastic scale-out
+    entry point; call INSTEAD of :func:`init`).  Blocks until some
+    member admits this process via :func:`admit_pending`, then binds
+    the new epoch's segments, receives every live window's state from
+    the sponsor over the exposed-window (broadcast) path, and returns
+    the :class:`~bluefog_tpu.resilience.join.JoinGrant`.
+
+    The joiner enters each window with **unit push-sum mass at the
+    sponsor's debiased estimate** — Σx/Σp over the grown membership is
+    the same value the survivors agreed on, so admission neither
+    creates nor destroys mass (journaled per window as
+    ``join_mass_admitted``; counter ``MASS_JOIN_ADMITTED``).
+    """
+    global _context
+    if _context is not None:
+        raise RuntimeError("join(): this process is already a member "
+                           "(join replaces init for new processes)")
+    j = job if job is not None else os.environ.get("BLUEFOG_ISLAND_JOB")
+    if not j:
+        raise RuntimeError("join() needs the job name: pass job= or set "
+                           "BLUEFOG_ISLAND_JOB")
+    board = _join.MembershipBoard(j)
+    req = board.post_request()
+    grant = board.wait_for_grant(req, timeout)
+    rec = grant.record
+    reg = _telemetry.get_registry()
+    if reg.enabled:
+        reg.rank, reg.job = grant.rank, j
+        reg.journal("join_granted", epoch=grant.epoch,
+                    sponsor=grant.sponsor,
+                    members=list(grant.members))
+    tr = _tracing.get_tracer()
+    if tr.enabled:
+        tr.set_identity(grant.rank, grant.size, j)
+        tr.instant("join_granted", aux=grant.epoch)
+    ejob = _join.epoch_job(j, grant.epoch)
+    ctx = _IslandContext(grant.local_rank, grant.size, ejob)
+    ctx.topology = _join.record_graph(rec)
+    ctx.base_job = j
+    ctx.epoch = grant.epoch
+    ctx.global_rank = grant.rank
+    ctx.members_global = grant.members
+    ctx.associated_p = bool(rec.get("associated_p", False))
+    _context = ctx
+    ctx.shm_job.barrier()  # aligns with _switch_epoch's first barrier
+    sponsor_local = grant.sponsor_local
+    for wmeta in sorted(rec["windows"], key=lambda w: w["name"]):
+        name = wmeta["name"]
+        dt = np.dtype(wmeta["dtype"])
+        win = _IslandWindow(name, np.zeros(tuple(wmeta["shape"]), dt),
+                            ctx, zero_init=True)
+        ctx.windows[name] = win
+        ctx.created_names.add(name)
+    ctx.shm_job.barrier()  # members restored their true (t, p) exposures
+    for name in sorted(ctx.windows):
+        win = ctx.windows[name]
+        # onboarding = the broadcast idiom: one one-sided read of the
+        # sponsor's exposure, debiased so the joiner enters at the value
+        # the survivors agree on, with unit push-sum mass of its own
+        a, p, _ = win.shm.read_exposed(sponsor_local)
+        x = np.asarray(a / p if (ctx.associated_p and p > 0.0) else a,
+                       dtype=win.shm.dtype)
+        win.self_tensor = x
+        win.p_self = 1.0
+        win.shm.expose(x, 1.0)
+        # seed my own slots with the entry value (creation contract: a
+        # pre-put combine is a no-op average, never a mix with zeros)
+        for k, s in enumerate(win.in_neighbors):
+            win.shm.write(ctx.rank, k, x, p=1.0, writer=s)
+            win._ledger_seen[k] = 1
+        win._seed_ver = 1
+        if reg.enabled:
+            reg.counter(_telemetry.MASS_JOIN_ADMITTED).add(1.0)
+            reg.journal("join_mass_admitted", window=name, p=1.0,
+                        epoch=grant.epoch)
+    ctx.shm_job.barrier()  # sponsor's exposure survived until here
+    if reg.enabled:
+        # the joiner's switch-point ledger is trivially balanced (all
+        # zeros) but journaled anyway: the membership-epoch rule audits
+        # EVERY member of the new view, joiners included
+        reg.journal("epoch_switch", old_epoch=None,
+                    new_epoch=grant.epoch, global_rank=grant.rank,
+                    joined=list(rec.get("joined", ())),
+                    **_ledger_totals(reg))
+    if tr.enabled:
+        tr.instant("join_complete", aux=grant.epoch)
+    return grant
 
 
 # ---------------------------------------------------------------------------
@@ -504,6 +851,12 @@ def win_create(tensor, name: str, zero_init: bool = False) -> bool:
     (reference ``bf.win_create`` [U]; collective like MPI_Win_create)."""
     ctx = _ctx()
     if name in ctx.windows:
+        # already exists — e.g. this process JOINED and the window came
+        # with the epoch record: adopt the caller's fusion meta so a
+        # pytree window still unpacks correctly after the replayed call
+        meta, _ = _island_fusion_split(tensor)
+        if meta is not None and name not in ctx.win_fusion:
+            ctx.win_fusion[name] = meta
         return False
     meta, tensor = _island_fusion_split(tensor)
     t = _to_host(tensor)
@@ -665,6 +1018,7 @@ def _edge_deposit(reg, win: _IslandWindow, op: str, src: int, dst: int,
     h[0].inc()
     h[1].add(int(nbytes))
     h[2].inc()
+    win._deposited_to[dst] = win._deposited_to.get(dst, 0) + 1
 
 
 def _op_hist(reg, win: _IslandWindow, op: str):

@@ -1278,6 +1278,48 @@ def unlink_segment(job: str, suffix: str) -> None:
     _unlink_name(seg_name(job, suffix))
 
 
+# ---------------------------------------------------------------------------
+# membership-epoch word (elastic membership; resilience/join.py)
+# ---------------------------------------------------------------------------
+
+
+def _epoch_word_path(job: str) -> str:
+    # named like every other job segment ("bf_<job>_epoch"), so crashed-run
+    # hygiene (unlink_all's prefix glob) reclaims it with the rest
+    return os.path.join(_FALLBACK_DIR, seg_name(job, "epoch")[1:])
+
+
+def membership_epoch(job: str) -> int:
+    """The job's current membership-epoch word (0 = the launch view; a
+    missing word reads as 0, so pre-elastic jobs are epoch 0 for free).
+
+    One 8-byte little-endian file in the shm dir: readers see either the
+    old or the new word (publish is an atomic rename), never a tear —
+    the cheap "has membership moved?" probe incumbents poll at round
+    barriers before touching the (heavier) membership board."""
+    try:
+        with open(_epoch_word_path(job), "rb") as f:
+            raw = f.read(8)
+    except OSError:
+        return 0
+    return struct.unpack("<Q", raw)[0] if len(raw) == 8 else 0
+
+
+def publish_membership_epoch(job: str, epoch: int) -> None:
+    """Atomically publish the membership-epoch word (monotone: a stale
+    publish below the current word is dropped, mirroring the monotone
+    dead-set contract on the shrink side)."""
+    if int(epoch) <= membership_epoch(job):
+        return
+    path = _epoch_word_path(job)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(struct.pack("<Q", int(epoch)))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 def unlink_all(job: str, window_names=()) -> None:
     """Best-effort cleanup of ALL of a job's segments (crashed-run hygiene).
 

@@ -58,6 +58,8 @@ _OP_BARRIER_T = 8      # rank-0 only: timed barrier, timeout rides in p
 _OP_HEARTBEAT = 9      # rank-0 only: renew rank `slot`'s lease
 _OP_LIVENESS = 10      # rank-0 only: age of rank `slot`'s lease (in p)
 _OP_CLOCK = 11         # rank-0 only: coordinator's monotonic clock (in p)
+_OP_JOIN_RANK = 12     # rank-0 only: grant a fresh global rank (in slot)
+_OP_EPOCH = 13         # rank-0 only: membership-epoch word (read/publish)
 
 #: human-readable op names: PeerTimeoutError context + telemetry labels
 _OP_NAMES = {
@@ -66,6 +68,7 @@ _OP_NAMES = {
     _OP_BARRIER: "barrier", _OP_REGISTER: "register", _OP_PING: "ping",
     _OP_BARRIER_T: "barrier_timed", _OP_HEARTBEAT: "heartbeat",
     _OP_LIVENESS: "liveness", _OP_CLOCK: "clock",
+    _OP_JOIN_RANK: "join_rank", _OP_EPOCH: "epoch",
 }
 
 # op, win_id, slot, mode, nbytes, p, trace — the trace word is LAST so
@@ -175,6 +178,15 @@ class _Server:
         # per-rank liveness words.
         self.lease_lock = threading.Lock()
         self.leases: Dict[int, float] = {}
+        # elastic-membership rendezvous (rank-0 coordinator only): the
+        # monotone fresh-rank counter (seeded past the launch world — a
+        # dead rank's id is never reissued) and the membership-epoch
+        # word.  The multi-host analogue of the shm membership board
+        # (resilience/join.py) for deployments where joiner and members
+        # share no filesystem.
+        self.join_lock = threading.Lock()
+        self.next_join_rank = nranks
+        self.membership_epoch = 0
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.sock.bind((host, port))
@@ -311,6 +323,20 @@ class _Server:
                     # late as possible so queueing before the read only
                     # widens the client's RTT bound, never biases it
                     _send_msg(conn, op, p=time.monotonic())
+                elif op == _OP_JOIN_RANK:
+                    with self.join_lock:
+                        granted = self.next_join_rank
+                        self.next_join_rank += 1
+                    _send_msg(conn, op, slot=granted)
+                elif op == _OP_EPOCH:
+                    # mode 1 publishes (monotone, like
+                    # shm_native.publish_membership_epoch), mode 0 reads;
+                    # either way the reply carries the current word
+                    with self.join_lock:
+                        if mode == 1 and slot > self.membership_epoch:
+                            self.membership_epoch = slot
+                        e = self.membership_epoch
+                    _send_msg(conn, op, slot=e)
                 elif op == _OP_PING:
                     _send_msg(conn, op)
                 else:
@@ -327,10 +353,18 @@ class _Server:
 
     def stop(self):
         self._stop = True
+        # shutdown() wakes a thread blocked in accept() (close() alone
+        # does not on Linux — the zombie thread would keep accepting on
+        # the fd number once the kernel reuses it for a later listener)
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self.sock.close()
         except OSError:
             pass
+        self.thread.join(timeout=5.0)
 
 
 class _Peers:

@@ -34,6 +34,7 @@ from jax import lax
 from bluefog_tpu.parallel._util import pvary as _util_pvary
 
 __all__ = [
+    "copy_to_tp_region",
     "reduce_from_tp_region",
     "column_parallel_dense",
     "row_parallel_dense",
@@ -62,11 +63,10 @@ def reduce_from_tp_region(x, axis_name: str = TP_AXIS):
     cotangent once, making sharded-weight gradients the exact shard of the
     full gradient.
 
-    Megatron's conjugate **f** operator (identity forward, psum backward,
-    restoring replicated activation cotangents at region entry) needs no
-    code here: JAX's varying-manual-axes typing auto-inserts ``pvary``
-    where the replicated stream meets a tp-varying operand, and ``pvary``'s
-    transpose is exactly that psum.
+    Megatron's conjugate **f** operator lives in
+    :func:`copy_to_tp_region` — apply it where the replicated stream
+    enters the tp region (done by :func:`tp_mlp` /
+    :func:`tp_self_attention` internally).
     """
     return lax.psum(x, axis_name)
 
@@ -82,6 +82,34 @@ def _reduce_bwd(axis_name, _, g):
 
 
 reduce_from_tp_region.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tp_region(x, axis_name: str = TP_AXIS):
+    """Megatron's **f** operator: identity forward, ``psum`` backward.
+
+    Where a tp-*replicated* activation (a norm output, an embedding
+    lookup) enters the tp-sharded region, each shard's transpose produces
+    only its partial contribution to the activation cotangent; the psum
+    backward assembles the full (and hence again replicated) cotangent, so
+    gradients of replicated leaves upstream — norm scales, embeddings —
+    come out exact and statically inferable as replicated under
+    ``shard_map``'s rep checking.  On older JAX (no varying-manual-axes
+    typing) the forward is a plain identity; on newer JAX it is
+    ``pvary``, typing the output tp-varying so no implicit cast is needed.
+    """
+    return _util_pvary(x, axis_name)
+
+
+def _copy_fwd(x, axis_name):
+    return _util_pvary(x, axis_name), None
+
+
+def _copy_bwd(axis_name, _, g):
+    return (lax.psum(g, axis_name),)
+
+
+copy_to_tp_region.defvjp(_copy_fwd, _copy_bwd)
 
 
 def column_parallel_dense(x, kernel, bias=None):
@@ -108,6 +136,7 @@ def row_parallel_dense(x, kernel, bias=None, axis_name: str = TP_AXIS):
 def tp_mlp(x, params, axis_name: str = TP_AXIS,
            activation: Callable = jax.nn.gelu):
     """Column-parallel up-projection, activation, row-parallel down."""
+    x = copy_to_tp_region(x, axis_name)
     h = activation(column_parallel_dense(x, params["wi"]))
     return row_parallel_dense(h, params["wo"], axis_name=axis_name)
 
@@ -129,6 +158,7 @@ def tp_self_attention(
     sequence sharding compose — different axes).
     """
     dtype = x.dtype
+    x = copy_to_tp_region(x, axis_name)
     q = jnp.einsum("btm,mhd->bthd", x, params["wq"]).astype(dtype)
     k = jnp.einsum("btm,mhd->bthd", x, params["wk"]).astype(dtype)
     v = jnp.einsum("btm,mhd->bthd", x, params["wv"]).astype(dtype)
@@ -273,9 +303,10 @@ def split_tp_params(params, axes):
     through :func:`shard_tp_params` and enter ``shard_map`` tp-varying
     (``P(..., "tp")``); replicated leaves must enter tp-*invariant*
     (``P()``, or ``P("bf_nodes")`` when stacked over a gossip axis) — then
-    JAX's varying-manual-axes machinery transposes the replicated→varying
-    boundary into exactly Megatron's f-operator psum, and every gradient
-    (including norms/embeddings) comes out correct with no manual sync.
+    :func:`copy_to_tp_region` (Megatron's f operator, applied by the block
+    functions at region entry) transposes the replicated→varying boundary
+    into a psum, and every gradient (including norms/embeddings) comes out
+    correct with no manual sync.
     Feeding replicated leaves through the stacked tp layout instead types
     them varying: their backward then mixes full (replicated-path) and
     partial (sharded-path) contributions per shard, which no uniform

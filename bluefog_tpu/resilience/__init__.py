@@ -31,8 +31,16 @@ from bluefog_tpu.resilience.degraded import (
 )
 from bluefog_tpu.resilience.healing import (
     HealedTopology,
+    grow_topology,
     heal_topology,
     healed_weight_matrix,
+)
+from bluefog_tpu.resilience.join import (
+    JoinGrant,
+    MembershipBoard,
+    epoch_job,
+    join_poll_s,
+    join_timeout_s,
 )
 
 __all__ = [
@@ -45,6 +53,12 @@ __all__ = [
     "renormalize_weights",
     "with_deadline",
     "HealedTopology",
+    "grow_topology",
     "heal_topology",
     "healed_weight_matrix",
+    "JoinGrant",
+    "MembershipBoard",
+    "epoch_job",
+    "join_poll_s",
+    "join_timeout_s",
 ]

@@ -11,7 +11,13 @@ Faults are injected two ways:
   ``BFTPU_CHAOS_DELAY_S``) makes the matching rank kill itself with
   SIGKILL mid-op — deterministic death at a protocol-relevant point
   (e.g. between the expose and the deposit of a win_put), which no
-  external signal can time reliably.
+  external signal can time reliably.  The same machinery schedules
+  **gray failures** (``BFTPU_CHAOS_SUSPEND_RANK`` /
+  ``BFTPU_CHAOS_SUSPEND_STEP`` / ``BFTPU_CHAOS_SUSPEND_S``: SIGSTOP
+  past the heartbeat timeout, then SIGCONT — see :func:`suspend_self`)
+  and **join admissions** (``BFTPU_CHAOS_JOIN_RANK`` /
+  ``BFTPU_CHAOS_JOIN_STEP``: the rank calls
+  ``islands.admit_pending()`` at the scheduled step).
 
 Mailbox corruption for protocol tests goes through
 :func:`corrupt_chunk` on a :class:`~bluefog_tpu.native.shm_native.
@@ -32,8 +38,11 @@ __all__ = [
     "suspend",
     "resume",
     "kill_self",
+    "suspend_self",
     "checkpoint",
     "schedule_kill",
+    "schedule_join",
+    "schedule_suspend",
     "clear_schedule",
     "corrupt_chunk",
 ]
@@ -41,6 +50,15 @@ __all__ = [
 _KILL_RANK = "BFTPU_CHAOS_KILL_RANK"
 _KILL_STEP = "BFTPU_CHAOS_KILL_STEP"
 _DELAY_S = "BFTPU_CHAOS_DELAY_S"
+_JOIN_RANK = "BFTPU_CHAOS_JOIN_RANK"
+_JOIN_STEP = "BFTPU_CHAOS_JOIN_STEP"
+_SUSPEND_RANK = "BFTPU_CHAOS_SUSPEND_RANK"
+_SUSPEND_STEP = "BFTPU_CHAOS_SUSPEND_STEP"
+_SUSPEND_S = "BFTPU_CHAOS_SUSPEND_S"
+
+_ALL_KEYS = (_KILL_RANK, _KILL_STEP, _DELAY_S,
+             _JOIN_RANK, _JOIN_STEP,
+             _SUSPEND_RANK, _SUSPEND_STEP, _SUSPEND_S)
 
 
 def kill(pid: int) -> None:
@@ -65,6 +83,26 @@ def kill_self() -> None:
     os.kill(os.getpid(), signal.SIGKILL)
 
 
+def suspend_self(duration_s: float) -> None:
+    """Gray-failure injection from inside: SIGSTOP the calling process
+    for ``duration_s`` seconds, then resume.  A stopped process cannot
+    un-stop itself, so a forked helper (immune to the parent's stop)
+    sleeps out the outage and delivers the SIGCONT.  Pick a duration
+    past the failure timeout and the detector declares the rank dead
+    while it is merely slow — the flapping-rank scenario the monotone
+    dead set exists for."""
+    pid = os.getpid()
+    child = os.fork()
+    if child == 0:
+        time.sleep(duration_s)
+        try:
+            os.kill(pid, signal.SIGCONT)
+        finally:
+            os._exit(0)
+    os.kill(pid, signal.SIGSTOP)  # execution stops HERE until SIGCONT
+    os.waitpid(child, 0)  # reap the resumer
+
+
 def schedule_kill(env: dict, rank: int, step: int,
                   delay_s: float = 0.0) -> dict:
     """Publish a kill schedule into an env mapping (pass to the worker
@@ -76,30 +114,70 @@ def schedule_kill(env: dict, rank: int, step: int,
     return env
 
 
+def schedule_join(env: dict, rank: int, step: int) -> dict:
+    """Publish a join-admission schedule: rank ``rank`` (or every rank,
+    with ``rank=-1`` — admission is a membership-wide switch, so -1 is
+    the common spelling) calls ``islands.admit_pending()`` at its
+    ``step``-th matching checkpoint."""
+    env[_JOIN_RANK] = str(int(rank))
+    env[_JOIN_STEP] = str(int(step))
+    return env
+
+
+def schedule_suspend(env: dict, rank: int, step: int,
+                     duration_s: float = 2.5) -> dict:
+    """Publish a gray-failure schedule: rank ``rank`` SIGSTOPs itself
+    for ``duration_s`` seconds at its ``step``-th matching checkpoint
+    (default 2.5s — past the 2s default failure timeout, so the outage
+    is long enough to be declared a death)."""
+    env[_SUSPEND_RANK] = str(int(rank))
+    env[_SUSPEND_STEP] = str(int(step))
+    env[_SUSPEND_S] = str(float(duration_s))
+    return env
+
+
 def clear_schedule() -> None:
-    for k in (_KILL_RANK, _KILL_STEP, _DELAY_S):
+    """Scrub EVERY chaos key from the calling process's environment —
+    kill, join, and suspend schedules alike (a stale key would replay
+    the fault in the next test's workers)."""
+    for k in _ALL_KEYS:
         os.environ.pop(k, None)
 
 
 _counters = {}
 
 
+def _matches(scheduled: Optional[str], rank: int) -> bool:
+    return scheduled is not None and int(scheduled) in (int(rank), -1)
+
+
 def checkpoint(rank: int, tag: str = "step") -> None:
     """Chaos instrumentation point: count invocations per (rank, tag)
-    and execute the scheduled fault when the counter hits the scheduled
-    step.  A no-op (two dict lookups) when no schedule is set."""
-    kill_rank = os.environ.get(_KILL_RANK)
-    if kill_rank is None:
+    and execute the scheduled fault(s) when the counter hits the
+    scheduled step.  A no-op (a few dict lookups) when no schedule is
+    set.  Suspend and join fire exactly once (``==`` their step); kill
+    fires at or after its step (``>=`` — the process is gone either
+    way)."""
+    env = os.environ
+    if (_KILL_RANK not in env and _JOIN_RANK not in env
+            and _SUSPEND_RANK not in env):
         return
-    delay = os.environ.get(_DELAY_S)
+    delay = env.get(_DELAY_S)
     if delay:
         time.sleep(float(delay))
-    if int(kill_rank) != int(rank):
-        return
     key = (int(rank), tag)
     n = _counters.get(key, 0) + 1
     _counters[key] = n
-    if n >= int(os.environ.get(_KILL_STEP, "1")):
+    if _matches(env.get(_SUSPEND_RANK), rank) \
+            and n == int(env.get(_SUSPEND_STEP, "1")):
+        suspend_self(float(env.get(_SUSPEND_S, "2.5")))
+    if _matches(env.get(_JOIN_RANK), rank) \
+            and n == int(env.get(_JOIN_STEP, "1")):
+        from bluefog_tpu import islands
+
+        islands.admit_pending()
+    if _matches(env.get(_KILL_RANK), rank) \
+            and n >= int(env.get(_KILL_STEP, "1")):
         kill_self()
 
 

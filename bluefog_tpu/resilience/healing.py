@@ -23,6 +23,16 @@ The healed plan drives both the SPMD emulation (windows.py) and the
 analysis rules; the island runtime applies the same membership change
 in place via degraded weights (see resilience/degraded.py) without
 reallocating its shm segments.
+
+:func:`grow_topology` is the inverse direction — elastic scale-OUT.
+Joining ranks are spliced into the sorted-member ring (their two ring
+neighbors are the attachment edges), the grown graph is symmetrized
+and MH re-weighted exactly like a healed one, and the recompiled
+plan's ``stochasticity_error`` pins the grown W doubly stochastic
+before any rank gossips under it.  Both directions return the same
+:class:`HealedTopology` record, so shrink/grow/shrink sequences
+compose: ``grown.topology`` (global-rank node labels restored via
+``to_global``) feeds straight back into the next membership change.
 """
 
 from __future__ import annotations
@@ -36,7 +46,17 @@ import numpy as np
 from bluefog_tpu import topology_util
 from bluefog_tpu.core.plan import CommPlan, compile_plan
 
-__all__ = ["HealedTopology", "heal_topology", "healed_weight_matrix"]
+__all__ = [
+    "HealedTopology",
+    "heal_topology",
+    "grow_topology",
+    "healed_weight_matrix",
+]
+
+# doubly-stochastic residual above which a grown plan is rejected
+# outright (float-epsilon scale; a symmetric MH-weighted graph lands
+# orders of magnitude below this)
+_STOCHASTICITY_TOL = 1e-9
 
 
 @dataclasses.dataclass(frozen=True)
@@ -50,6 +70,7 @@ class HealedTopology:
     to_local: Dict[int, int]     # global rank -> local node id
     to_global: Tuple[int, ...]   # local node id -> global rank
     reconnected: bool            # ring edges were added for connectivity
+    joined: Tuple[int, ...] = () # sorted global ranks spliced in (grow)
 
     @property
     def size(self) -> int:
@@ -120,6 +141,84 @@ def heal_topology(topo: nx.DiGraph, dead: Iterable[int]) -> HealedTopology:
         to_local=to_local,
         to_global=to_global,
         reconnected=reconnected,
+    )
+
+
+def grow_topology(topo: nx.DiGraph,
+                  joiners: Iterable[int]) -> HealedTopology:
+    """Splice ``joiners`` (fresh global ranks) into ``topo`` and return
+    a connected, MH-weighted, doubly-stochastic grown topology with a
+    freshly compiled plan — :func:`heal_topology`'s twin for elastic
+    scale-out.
+
+    The attachment rule is deterministic (every member computes the
+    same grown graph from the same membership view, no consensus round
+    needed — the grow-side mirror of the monotone-dead-set argument):
+    each joiner is connected bidirectionally to its two neighbors in
+    the sorted circular order of the grown member set, i.e. spliced
+    into the member ring.  Existing edges are kept (symmetrized), so
+    the incumbents' gossip locality is preserved and only the splice
+    points gain degree.
+
+    Raises ValueError for an empty joiner set or a joiner already in
+    the topology, and RuntimeError if the grown plan's
+    ``stochasticity_error`` is not float-epsilon doubly stochastic
+    (cannot happen for a symmetric MH-weighted graph; the check pins
+    the contract before any rank gossips under the grown W).
+    """
+    nodes = set(int(n) for n in topo.nodes)
+    join_set = set(int(r) for r in joiners)
+    if not join_set:
+        raise ValueError("no joiners: grow_topology needs >= 1 new rank")
+    if join_set & nodes:
+        raise ValueError(
+            f"joiners {sorted(join_set & nodes)} already in topology "
+            "(a restarted rank must rejoin under a FRESH global rank)")
+
+    members = tuple(sorted(nodes | join_set))
+    G = _symmetrized_induced(topo, nodes)
+    G.add_nodes_from(sorted(join_set))
+    m = len(members)
+    for j in sorted(join_set):
+        i = members.index(j)
+        for nb in (members[i - 1], members[(i + 1) % m]):
+            if nb != j:
+                G.add_edge(j, nb)
+                G.add_edge(nb, j)
+
+    reconnected = False
+    if m > 1 and not nx.is_strongly_connected(G):
+        # splicing joiners cannot disconnect incumbents, but the OLD
+        # graph may already have been disconnected — same ring repair
+        # as heal_topology
+        reconnected = True
+        for i in range(m):
+            u, v = members[i], members[(i + 1) % m]
+            if u != v:
+                G.add_edge(u, v)
+                G.add_edge(v, u)
+
+    to_global = members
+    to_local = {g: i for i, g in enumerate(members)}
+    H = nx.relabel_nodes(G, to_local, copy=True)
+    topology_util.MetropolisHastingsWeights(H)
+    H.graph["grown_from"] = tuple(sorted(join_set))
+
+    plan = compile_plan(H)
+    row_err, col_err = plan.stochasticity_error()
+    if max(row_err, col_err) > _STOCHASTICITY_TOL:
+        raise RuntimeError(
+            f"grown plan not doubly stochastic: row={row_err:.3e} "
+            f"col={col_err:.3e} (tol {_STOCHASTICITY_TOL:.0e})")
+    return HealedTopology(
+        survivors=members,
+        dead=(),
+        topology=H,
+        plan=plan,
+        to_local=to_local,
+        to_global=to_global,
+        reconnected=reconnected,
+        joined=tuple(sorted(join_set)),
     )
 
 

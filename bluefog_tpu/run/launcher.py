@@ -23,6 +23,10 @@ Usage:
   bftpu-run --islands 4 -H a:2,b:2 python async_train.py
                                                # islands across machines
                                                # (shm intra-host, TCP inter)
+  bftpu-run --islands 4 --self-heal python async_train.py
+                                               # elastic fleet: signal-killed
+                                               # ranks respawn as joiners
+  bftpu-run --attach JOB scale +2              # resize a running islands job
 """
 
 from __future__ import annotations
@@ -35,9 +39,12 @@ import signal
 import socket
 import subprocess
 import sys
+import tempfile
+import threading
 import time
 
-__all__ = ["main", "build_env", "parse_hosts", "ssh_command", "env_whitelist"]
+__all__ = ["main", "build_env", "parse_hosts", "ssh_command", "env_whitelist",
+           "control_sock_path"]
 
 # Env forwarded to ssh-spawned ranks, by prefix (the reference forwards an
 # explicit whitelist plus every ``-x NAME``; prefixes cover our namespaced
@@ -278,6 +285,217 @@ def _supervise(ranks, timeout: float) -> int:
     return code
 
 
+def _supervise_islands(ranks, timeout: float, spawn_joiner, self_heal: bool,
+                       state: dict) -> int:
+    """:func:`_supervise`, plus the elastic behaviors of an islands run:
+    a control-socket ``scale`` request spawns extra JOINER ranks
+    mid-run, and with ``--self-heal`` a rank that dies BY SIGNAL
+    (SIGKILL'd mid-``win_put``, OOM-killed, ...) is replaced by a fresh
+    joiner — never its old global rank, per the monotone dead-set
+    contract — while the survivors heal around the corpse.  A rank that
+    exits nonzero on its own still fails the run (user-code bugs must
+    not loop forever through respawns); the respawn budget
+    (``BFTPU_MAX_RESPAWNS``) bounds the healing too."""
+    code = 0
+    deadline = time.monotonic() + timeout if timeout else None
+    grace_deadline = None
+    live = list(ranks)
+    respawns_left = _respawn_budget()
+
+    def teardown(sig=signal.SIGTERM):
+        _kill_remote(ranks)
+        _kill_local(ranks, sig)
+
+    try:
+        while live:
+            with state["lock"]:
+                todo = state["scale_requests"]
+                state["scale_requests"] = 0
+            for _ in range(todo):
+                rk = spawn_joiner()
+                ranks.append(rk)
+                live.append(rk)
+                with state["lock"]:
+                    state["joiners"] += 1
+                print(f"bftpu-run: scale request — spawned joiner "
+                      f"(pid {rk.proc.pid})", file=sys.stderr)
+            for rk in list(live):
+                rc = rk.proc.poll()
+                if rc is None:
+                    continue
+                live.remove(rk)
+                if rc < 0 and self_heal and code == 0:
+                    if respawns_left > 0:
+                        respawns_left -= 1
+                        nk = spawn_joiner()
+                        ranks.append(nk)
+                        live.append(nk)
+                        with state["lock"]:
+                            state["joiners"] += 1
+                        print(
+                            f"bftpu-run: rank died on signal {-rc}; "
+                            f"self-heal spawned replacement joiner "
+                            f"(pid {nk.proc.pid}, "
+                            f"{respawns_left} respawn(s) left)",
+                            file=sys.stderr)
+                        continue
+                    print("bftpu-run: respawn budget exhausted "
+                          "(BFTPU_MAX_RESPAWNS)", file=sys.stderr)
+                if rc != 0 and code == 0:
+                    code = rc
+                    grace = _launch_grace_s()
+                    if grace > 0 and live:
+                        grace_deadline = time.monotonic() + grace
+                        print(
+                            f"bftpu-run: a rank failed (exit {rc}); "
+                            f"giving {len(live)} surviving rank(s) "
+                            f"{grace:g}s to finish", file=sys.stderr)
+                    else:
+                        teardown()
+            with state["lock"]:
+                state["live"] = len(live)
+            if live and grace_deadline is not None \
+                    and time.monotonic() > grace_deadline:
+                print(f"bftpu-run: grace expired; killing {len(live)} "
+                      f"surviving rank(s)", file=sys.stderr)
+                grace_deadline = None
+                teardown()
+            if live and deadline is not None and time.monotonic() > deadline:
+                print(f"bftpu-run: timeout after {timeout:g}s; killing "
+                      f"{len(live)} live rank(s)", file=sys.stderr)
+                teardown()
+                time.sleep(2.0)
+                _kill_local(ranks, signal.SIGKILL)
+                return 124
+            if live:
+                time.sleep(0.05)
+    except KeyboardInterrupt:
+        teardown(signal.SIGINT)
+        code = 130
+    return code
+
+
+def control_sock_path(job: str) -> str:
+    """The supervisor's control socket for an islands run — what
+    ``bftpu-run --attach JOB`` dials to resize the fleet without a
+    restart."""
+    return os.path.join(tempfile.gettempdir(), f"bftpu-run-{job}.sock")
+
+
+def _respawn_budget() -> int:
+    """How many signal-killed ranks a ``--self-heal`` run will replace
+    (``BFTPU_MAX_RESPAWNS``, default 2) — a budget, not a loop: a rank
+    that keeps getting killed should eventually fail the run."""
+    try:
+        return max(0, int(os.environ.get("BFTPU_MAX_RESPAWNS", "2")))
+    except ValueError:
+        return 2
+
+
+class _Control:
+    """Line-JSON control server on a unix socket: ``scale`` enqueues
+    extra joiner ranks, ``status`` reports the fleet.  Handlers only
+    enqueue/read — the supervisor loop owns all process state."""
+
+    def __init__(self, job: str, state: dict):
+        self.path = control_sock_path(job)
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+        self.state = state  # {"lock", "scale_requests", "live", "joiners"}
+        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self.sock.bind(self.path)
+        self.sock.listen(4)
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+        self.thread.start()
+
+    def _handle(self, req: dict) -> dict:
+        cmd = req.get("cmd")
+        st = self.state
+        if cmd == "scale":
+            n = int(req.get("n", 1))
+            if n < 1:
+                return {"ok": False, "error": f"scale n must be >= 1, got {n}"}
+            with st["lock"]:
+                st["scale_requests"] += n
+            return {"ok": True, "queued": n}
+        if cmd == "status":
+            with st["lock"]:
+                return {"ok": True, "live": st["live"],
+                        "joiners": st["joiners"],
+                        "pending_scale": st["scale_requests"]}
+        return {"ok": False, "error": f"unknown command {cmd!r}"}
+
+    def _loop(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            try:
+                line = conn.makefile("r").readline()
+                rep = self._handle(json.loads(line))
+            except Exception as e:  # noqa: BLE001 — report, don't die
+                rep = {"ok": False, "error": repr(e)}
+            try:
+                conn.sendall((json.dumps(rep) + "\n").encode())
+            except OSError:
+                pass
+            conn.close()
+
+    def stop(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+def attach_main(job: str, command) -> int:
+    """``bftpu-run --attach JOB [scale +K | status]`` — the client side
+    of the control socket."""
+    if not command:
+        command = ["status"]
+    if command[0] == "scale":
+        if len(command) < 2:
+            print("bftpu-run: scale needs a count: scale +K",
+                  file=sys.stderr)
+            return 2
+        try:
+            n = int(command[1].lstrip("+"))
+        except ValueError:
+            print(f"bftpu-run: bad scale count {command[1]!r}",
+                  file=sys.stderr)
+            return 2
+        req = {"cmd": "scale", "n": n}
+    elif command[0] == "status":
+        req = {"cmd": "status"}
+    else:
+        print(f"bftpu-run: unknown control command {command[0]!r} "
+              "(expected: scale +K, status)", file=sys.stderr)
+        return 2
+    path = control_sock_path(job)
+    try:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(path)
+        s.sendall((json.dumps(req) + "\n").encode())
+        line = s.makefile("r").readline()
+        s.close()
+    except OSError as e:
+        print(f"bftpu-run: cannot reach {path} — is the islands run "
+              f"still up? ({e})", file=sys.stderr)
+        return 1
+    print(line.strip())
+    try:
+        return 0 if json.loads(line).get("ok") else 1
+    except ValueError:
+        return 1
+
+
 def _pick_port() -> int:
     """An ephemeral port for the rendezvous.  Bind-then-close is a TOCTOU
     (another process may grab it before the children bind), and for a
@@ -357,11 +575,32 @@ def main(argv=None) -> int:
         default=None,
         help="island job name (shared-memory namespace); default: pid-derived",
     )
+    parser.add_argument(
+        "--self-heal",
+        action="store_true",
+        help="islands mode: replace a signal-killed rank with a fresh "
+        "joiner process (up to BFTPU_MAX_RESPAWNS) instead of failing "
+        "the run — the survivors heal, the replacement rejoins under a "
+        "new global rank",
+    )
+    parser.add_argument(
+        "--attach",
+        default=None,
+        metavar="JOB",
+        help="dial a running islands job's control socket instead of "
+        "launching: `bftpu-run --attach JOB scale +K` admits K extra "
+        "ranks, `... status` reports the fleet",
+    )
     parser.add_argument("--timeline", default=None, help="write a Chrome trace here")
     parser.add_argument("-v", "--verbose", action="store_true")
     parser.add_argument("command", nargs=argparse.REMAINDER, help="program to run")
     args = parser.parse_args(argv)
 
+    if args.attach:
+        cmd = args.command
+        if cmd and cmd[0] == "--":
+            cmd = cmd[1:]
+        return attach_main(args.attach, cmd)
     if not args.command:
         parser.error("no command given; usage: bftpu-run [options] python train.py")
     cmd = args.command
@@ -382,7 +621,7 @@ def main(argv=None) -> int:
     env = build_env(args)
     if args.islands:
         return _run_islands(cmd, env, args.islands, args.job, hosts,
-                            args.timeout)
+                            args.timeout, self_heal=args.self_heal)
     if args.np is not None and args.np > 1 and args.process_id is None:
         # `-np N` with no explicit process id: WE are the process launcher
         # (the reference's `bfrun -np N` execs mpirun which forks the ranks
@@ -516,17 +755,39 @@ def _collect_traces(env: dict, job: str) -> None:
         print(f"bftpu-run: trace merge failed: {e}", file=sys.stderr)
 
 
-def _run_islands(cmd, env, nranks: int, job, hosts, timeout: float) -> int:
+def _run_islands(cmd, env, nranks: int, job, hosts, timeout: float,
+                 self_heal: bool = False) -> int:
     """Fork N island processes (the `mpirun -np N` shape of the reference's
     launcher [U]).  With ``-H``, ranks spawn on their hosts over ssh and
     the hostmap/coordinator env is set so window traffic rides shared
     memory intra-host and TCP inter-host (routed transport).  Returns the
-    first nonzero child exit code, tearing the others down on failure."""
+    first nonzero child exit code, tearing the others down on failure.
+
+    Single-host runs are ELASTIC: a control socket
+    (:func:`control_sock_path`) accepts ``scale`` requests from
+    ``bftpu-run --attach JOB scale +K``, and ``--self-heal`` replaces
+    signal-killed ranks with fresh joiner processes
+    (``BLUEFOG_ISLAND_JOINER=1`` routes ``islands.init`` to
+    ``islands.join``).  Multi-host fleets keep the fixed-size
+    supervisor — cross-host respawn placement is not implemented."""
     job = job or f"bfrun{os.getpid()}"
     by_rank = _rank_hosts(hosts, nranks)
     multi_host = hosts is not None and len(set(by_rank)) > 1
     tag = f"bfrun-{os.getpid()}-{int(time.time())}"
     code = 1
+
+    def spawn_joiner() -> _Rank:
+        jc = dict(env)
+        jc.pop("BLUEFOG_ISLAND_RANK", None)
+        jc["BLUEFOG_ISLAND_JOINER"] = "1"
+        jc["BLUEFOG_ISLAND_SIZE"] = str(nranks)
+        jc["BLUEFOG_ISLAND_JOB"] = job
+        spawn_joiner.idx += 1
+        return _spawn_rank("localhost", cmd, jc, tag,
+                           10000 + spawn_joiner.idx)
+
+    spawn_joiner.idx = 0
+
     for attempt in (0, 1):
         coord = (f"{_head_address(by_rank)}:{_pick_port()}"
                  if multi_host else None)
@@ -548,9 +809,23 @@ def _run_islands(cmd, env, nranks: int, job, hosts, timeout: float) -> int:
                     socket.getfqdn() if _is_local_host(by_rank[r])
                     else by_rank[r])
             ranks.append(_spawn_rank(by_rank[r], cmd, child_env, tag, r))
+        control = None
         try:
-            code = _supervise(ranks, timeout)
+            if multi_host:
+                code = _supervise(ranks, timeout)
+            else:
+                state = {"lock": threading.Lock(), "scale_requests": 0,
+                         "live": len(ranks), "joiners": 0}
+                try:
+                    control = _Control(job, state)
+                except OSError as e:
+                    print(f"bftpu-run: control socket unavailable ({e}); "
+                          "run is not resizable", file=sys.stderr)
+                code = _supervise_islands(ranks, timeout, spawn_joiner,
+                                          self_heal, state)
         finally:
+            if control is not None:
+                control.stop()
             _cleanup_island_segments(job, by_rank)
             _collect_telemetry(env, job)
             _collect_traces(env, job)

@@ -45,6 +45,7 @@ __all__ = [
     "LEDGER_COLLECTED",
     "LEDGER_DRAINED",
     "LEDGER_PENDING",
+    "MASS_JOIN_ADMITTED",
     "DEFAULT_LATENCY_BUCKETS_S",
     "telemetry_dir",
     "Counter",
@@ -73,6 +74,16 @@ LEDGER_DEPOSITS = "shm.ledger.deposits"
 LEDGER_COLLECTED = "shm.ledger.collected"
 LEDGER_DRAINED = "shm.ledger.drained"
 LEDGER_PENDING = "shm.ledger.pending"
+
+#: Elastic-membership extension of the mass ledger: push-sum mass a
+#: joiner brings INTO the network (p = 1.0 per window, carried at the
+#: sponsor's debiased estimate, so Σx/Σp is preserved at consensus).
+#: Every admission also journals an ``epoch_switch`` event holding the
+#: four ledger counters at the switch barrier — the per-epoch balance
+#: the analysis ``resilience.membership-epoch`` rule checks (no
+#: committed deposit from epoch e is consumed under view e+1 without
+#: appearing as collected/drained/pending at the switch).
+MASS_JOIN_ADMITTED = "resilience.join_mass_admitted"
 
 #: Default histogram bucket upper bounds for op latencies, in seconds
 #: (1 µs .. 10 s, roughly half-decade steps; +Inf bucket is implicit).

@@ -17,6 +17,7 @@ import socket
 import threading
 import time
 
+import networkx as nx
 import numpy as np
 import pytest
 
@@ -472,3 +473,427 @@ def test_launcher_zero_grace_restores_immediate_teardown(tmp_path):
     assert proc.returncode == 9, (proc.returncode, proc.stderr[-800:])
     assert time.monotonic() - t0 < 60
     assert not out.exists()  # the sleeper was torn down, not waited for
+
+# ---------------------------------------------------------------------------
+# elastic membership: grow-side healing, the join protocol, churn
+# ---------------------------------------------------------------------------
+
+
+def test_grow_topology_is_doubly_stochastic_and_fresh_ranks_only():
+    G = topology_util.ExponentialTwoGraph(4)
+    grown = healing.grow_topology(G, [4, 5])
+    assert grown.joined == (4, 5)
+    assert grown.to_global == (0, 1, 2, 3, 4, 5)
+    assert grown.dead == ()
+    row, col = grown.plan.stochasticity_error()
+    assert row < 1e-9 and col < 1e-9
+    # a joiner may NOT reuse a present rank (the monotone dead-set
+    # contract: a restarted rank rejoins under a FRESH global rank)
+    with pytest.raises(ValueError, match="FRESH"):
+        healing.grow_topology(G, [2])
+    with pytest.raises(ValueError, match="joiners"):
+        healing.grow_topology(G, [])
+
+
+def test_grow_after_heal_splices_into_survivor_topology():
+    """Shrink (heal) then grow: the corpse stays excised, the joiner is
+    spliced in, and the grown plan is doubly stochastic."""
+    healed = healing.heal_topology(topology_util.StarGraph(6), dead=[0])
+    Gg = nx.relabel_nodes(healed.topology,
+                          dict(enumerate(healed.to_global)), copy=True)
+    grown = healing.grow_topology(Gg, [6])
+    assert 0 not in grown.to_global
+    assert grown.to_global == (1, 2, 3, 4, 5, 6)
+    assert grown.joined == (6,)
+    row, col = grown.plan.stochasticity_error()
+    assert row < 1e-9 and col < 1e-9
+
+
+def test_membership_board_grant_roundtrip(tmp_path, monkeypatch):
+    from bluefog_tpu.resilience import join as join_mod
+
+    monkeypatch.setattr(shm_native, "_FALLBACK_DIR", str(tmp_path))
+    board = join_mod.MembershipBoard("bjob")
+    board.ensure(3)
+    board.ensure(3)  # idempotent
+    assert board.pending_requests() == []
+    req = board.post_request()
+    assert [r["req"] for r in board.pending_requests()] == [req]
+    G = topology_util.ExponentialTwoGraph(3)
+    windows = [{"name": "w", "shape": [2], "dtype": "float64"}]
+    rec = board.grant(0, [0, 1, 2], G, windows, False, prev_epoch=0)
+    assert rec["epoch"] == 1
+    assert rec["members"] == [0, 1, 2, 3]
+    assert rec["granted"][req] == 3  # fresh, off the monotone counter
+    # a raced second sponsor finds the record present, unchanged
+    rec2 = board.grant(1, [0, 1, 2], G, windows, False, prev_epoch=0)
+    assert rec2 == rec
+    g = board.wait_for_grant(req, timeout=1.0)
+    assert (g.rank, g.epoch, g.sponsor) == (3, 1, 0)
+    assert g.local_rank == 3 and g.size == 4
+    # the cheap change probe moved with the commit, and is monotone
+    assert shm_native.membership_epoch("bjob") == 1
+    shm_native.publish_membership_epoch("bjob", 0)
+    assert shm_native.membership_epoch("bjob") == 1
+    # every member rebuilds the SAME dense MH-weighted graph
+    H = join_mod.record_graph(rec)
+    assert set(H.nodes) == {0, 1, 2, 3}
+    from bluefog_tpu.core.plan import compile_plan
+    row, col = compile_plan(H).stochasticity_error()
+    assert row < 1e-9 and col < 1e-9
+
+
+def test_join_grant_timeout_names_the_cure(tmp_path, monkeypatch):
+    from bluefog_tpu.resilience import join as join_mod
+
+    monkeypatch.setattr(shm_native, "_FALLBACK_DIR", str(tmp_path))
+    board = join_mod.MembershipBoard("tjob")
+    with pytest.raises(RuntimeError, match="membership board"):
+        board.post_request()  # no board: the job is not running
+    board.ensure(2)
+    req = board.post_request()
+    with pytest.raises(TimeoutError, match="admit_pending"):
+        board.wait_for_grant(req, timeout=0.2)
+
+
+def test_tcp_join_rank_and_epoch_ops():
+    """The coordinator-mediated rendezvous primitives for multi-host
+    deployments: fresh ranks off a monotone counter seeded past the
+    launch world, and a monotone membership-epoch word."""
+    from bluefog_tpu.native import tcp_transport as tt
+
+    srv = tt._Server(rank=0, nranks=4, host="127.0.0.1")
+    try:
+        peers = tt._Peers({0: f"127.0.0.1:{srv.port}"})
+        r1 = peers.request(0, tt._OP_JOIN_RANK)
+        r2 = peers.request(0, tt._OP_JOIN_RANK)
+        assert (r1[2], r2[2]) == (4, 5)  # never reissues, never reuses 0-3
+        assert peers.request(0, tt._OP_EPOCH)[2] == 0
+        assert peers.request(0, tt._OP_EPOCH, slot=3, mode=1)[2] == 3
+        assert peers.request(0, tt._OP_EPOCH, slot=1, mode=1)[2] == 3  # monotone
+        assert peers.request(0, tt._OP_EPOCH)[2] == 3
+        peers.close()
+    finally:
+        srv.stop()
+
+
+def _worker_admit_after_kill(rank, size):
+    """np=4 exp2 gossip; chaos SIGKILLs one rank; survivors heal to 3,
+    then admit a replacement joiner and gossip on the grown membership.
+    Returns (pre-join consensus, switch-point ledger totals, post-join
+    state)."""
+    from bluefog_tpu.telemetry import registry as telem
+
+    islands.set_topology(topology_util.ExponentialTwoGraph(size))
+    islands.win_create(np.full(3, float(rank * 10), np.float64), "ej")
+    islands.barrier()
+    deadline = time.monotonic() + 120.0
+    while time.monotonic() < deadline and not islands.dead_ranks():
+        chaos.checkpoint(rank, "egossip")  # the victim dies here
+        islands.win_put(islands.win_sync("ej"), "ej")
+        islands.win_update("ej")
+        time.sleep(0.002)
+    assert islands.dead_ranks(), "victim death never detected"
+    islands.heal()
+    # degraded gossip to survivor consensus BEFORE the join
+    for _ in range(150):
+        islands.win_put(islands.win_sync("ej"), "ej")
+        islands.win_update("ej")
+        time.sleep(0.002)
+    pre = islands.win_sync("ej").copy()
+    rec = None
+    while rec is None and time.monotonic() < deadline:
+        rec = islands.admit_pending(timeout=30)
+        if rec is None:
+            time.sleep(0.02)
+    assert rec is not None, "no joiner was admitted"
+    # the switch-point ledger (nothing ran since the epoch switch)
+    ledger = islands._ledger_totals(telem.get_registry())
+    # post-join gossip on the grown membership
+    for _ in range(150):
+        islands.win_put(islands.win_sync("ej"), "ej")
+        islands.win_update("ej")
+        time.sleep(0.002)
+    post = islands.win_sync("ej").copy()
+    return (islands.global_rank(), islands.membership_epoch(),
+            islands.members(), pre, ledger, post)
+
+
+def _proc_joiner_after_kill(job, q):
+    import numpy as _np
+
+    from bluefog_tpu import islands as isl
+    from bluefog_tpu.resilience import join as join_mod
+    from bluefog_tpu.telemetry import registry as telem
+
+    board = join_mod.MembershipBoard(job)
+    deadline = time.monotonic() + 60.0
+    while board.read() is None and time.monotonic() < deadline:
+        time.sleep(0.05)  # the members have not initialized yet
+    g = isl.join(job=job, timeout=60)
+    entry = _np.array(isl.win_sync("ej"))
+    ledger = isl._ledger_totals(telem.get_registry())
+    for _ in range(150):
+        isl.win_put(isl.win_sync("ej"), "ej")
+        isl.win_update("ej")
+        time.sleep(0.002)
+    q.put((g.rank, g.epoch, tuple(g.members), entry, ledger,
+           _np.array(isl.win_sync("ej"))))
+    isl.shutdown(unlink=False)
+
+
+@pytest.mark.slow
+def test_kill_heal_join_restores_np4_consensus(monkeypatch):
+    """The elastic acceptance e2e: np=4 over exp2, one rank SIGKILLed
+    mid-gossip; survivors heal to 3 and reach consensus; a replacement
+    process joins (fresh global rank 4 — never the corpse's), every
+    member switches to epoch 1, and the grown 4-member job converges to
+    the SAME value the survivors had agreed on: admission neither
+    created nor destroyed mass.  The switch-point mass ledger balances
+    globally (deposits == collected + drained + pending summed across
+    members)."""
+    import multiprocessing as mp
+
+    size, victim = 4, 1
+    job = f"elastic{os.getpid()}"
+    monkeypatch.setenv("BFTPU_FAILURE_TIMEOUT_S", "1.0")
+    monkeypatch.setenv("BFTPU_TELEMETRY", "1")
+    chaos.schedule_kill(os.environ, rank=victim, step=3)
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    joiner = ctx.Process(target=_proc_joiner_after_kill, args=(job, q))
+    joiner.start()
+    try:
+        res = islands.spawn(_worker_admit_after_kill, size, job=job,
+                            timeout=300.0, allow_failures=True)
+        jrank, jepoch, jmembers, jentry, jledger, jout = q.get(timeout=60)
+    finally:
+        chaos.clear_schedule()
+        joiner.join(timeout=30)
+        if joiner.is_alive():
+            joiner.terminate()
+        shm_native.unlink_all(job, ["ej"])
+    assert res[victim] is None, "the victim was supposed to die"
+    survivors = [r for r in range(size) if r != victim]
+    pres, posts, ledgers = [], [], []
+    for r in survivors:
+        assert res[r] is not None, f"survivor {r} produced no result"
+        grank, epoch, members, pre, ledger, post = res[r]
+        assert grank == r          # stable global identity
+        assert epoch == 1
+        assert members == (0, 2, 3, 4)  # corpse excised, fresh rank 4
+        pres.append(pre)
+        ledgers.append(ledger)
+        posts.append(post)
+    assert (jrank, jepoch) == (4, 1)
+    assert jmembers == (0, 2, 3, 4)
+    # survivors had reached consensus before the join
+    pre_flat = np.stack(pres)
+    assert float(pre_flat.max() - pre_flat.min()) < 1.0, pre_flat
+    pre_consensus = float(pre_flat.mean())
+    # the joiner entered AT that consensus (sponsor's debiased estimate)
+    assert np.allclose(jentry, pre_consensus, atol=1.0), (
+        jentry, pre_consensus)
+    # post-join: all four agree, at the SAME value — the join moved no mass
+    all_post = np.stack(posts + [jout])
+    assert float(all_post.max() - all_post.min()) < 1.0, all_post
+    assert abs(float(all_post.mean()) - pre_consensus) < 1.0
+    # switch-point mass ledger balances globally across the join barrier
+    ledgers.append(jledger)
+    dep = sum(l["deposits"] for l in ledgers)
+    acc = sum(l["collected"] + l["drained"] + l["pending"] for l in ledgers)
+    assert dep == pytest.approx(acc), ledgers
+
+
+def _worker_flapping(rank, size):
+    """np=3 gossip; rank 2 SIGSTOPs past the failure timeout, then
+    resumes (the gray failure).  Survivors declare it dead and heal; the
+    zombie wakes, keeps gossiping into slots nobody reads, and exits
+    cleanly — absorbed, never double-counted."""
+    islands.set_topology(topology_util.ExponentialTwoGraph(size))
+    islands.win_create(np.full(2, float(rank * 10), np.float64), "fl")
+    islands.barrier()
+    deadline = time.monotonic() + 60.0
+    rounds = 0
+    while time.monotonic() < deadline and rounds < 400:
+        chaos.checkpoint(rank, "flap")  # rank 2 freezes 2.5s here
+        islands.win_put(islands.win_sync("fl"), "fl")
+        islands.win_update("fl")
+        rounds += 1
+        if islands.dead_ranks():
+            break
+        time.sleep(0.005)
+    dead = sorted(islands.dead_ranks())
+    if dead:
+        islands.heal()
+        for _ in range(150):
+            islands.win_put(islands.win_sync("fl"), "fl")
+            islands.win_update("fl")
+            time.sleep(0.002)
+    return (rank, dead, islands.win_sync("fl").copy())
+
+
+@pytest.mark.slow
+def test_flapping_rank_is_absorbed_cleanly(monkeypatch):
+    """SIGSTOP/SIGCONT churn: the suspended rank is declared dead while
+    stopped (monotone — it STAYS dead to the survivors), resumes, and
+    the run ends cleanly: survivors converge without it, the zombie's
+    late deposits land in slots nobody reads, and every process exits
+    zero."""
+    size, flapper = 3, 2
+    monkeypatch.setenv("BFTPU_FAILURE_TIMEOUT_S", "1.0")
+    chaos.schedule_suspend(os.environ, rank=flapper, step=5,
+                           duration_s=2.5)
+    try:
+        res = islands.spawn(_worker_flapping, size, timeout=300.0,
+                            allow_failures=True)
+    finally:
+        chaos.clear_schedule()
+    for r in range(size):
+        assert res[r] is not None, f"rank {r} crashed"
+    survivors = [r for r in range(size) if r != flapper]
+    outs = []
+    for r in survivors:
+        rank_, dead, out = res[r]
+        assert dead == [flapper], (r, dead)  # declared dead while stopped
+        outs.append(out)
+    # survivors converged without the flapper; values stay in the hull
+    flat = np.stack(outs)
+    assert float(flat.max() - flat.min()) < 1.0, flat
+    assert flat.min() > -1e-9 and flat.max() < 20.0 + 1e-9
+    # the zombie itself came back, saw no deaths, and exited cleanly
+    _, zdead, zout = res[flapper]
+    assert zdead == []
+    assert np.all(np.isfinite(zout))
+
+
+@pytest.mark.slow
+def test_launcher_self_heal_respawns_killed_rank(tmp_path):
+    """``bftpu-run --islands 3 --self-heal``: one rank SIGKILLs itself;
+    the supervisor spawns a replacement joiner (BLUEFOG_ISLAND_JOINER=1
+    routes its init() to join()), the survivors heal and admit it, and
+    the whole run exits zero with 3 members in epoch 1."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    outdir = tmp_path
+    script = (
+        "import os, time\n"
+        "import numpy as np\n"
+        "from bluefog_tpu import islands\n"
+        "from bluefog_tpu.resilience import chaos\n"
+        "islands.init()\n"
+        "joiner = os.environ.get('BLUEFOG_ISLAND_JOINER') == '1'\n"
+        "islands.win_create(np.full(2, 1.0 * islands.global_rank()), 'sh')\n"
+        "if not joiner:\n"
+        "    if islands.rank() == 1:\n"
+        "        time.sleep(0.5)\n"
+        "        chaos.kill_self()\n"
+        "    deadline = time.monotonic() + 60.0\n"
+        "    while time.monotonic() < deadline and not islands.dead_ranks():\n"
+        "        islands.win_put(islands.win_sync('sh'), 'sh')\n"
+        "        islands.win_update('sh')\n"
+        "        time.sleep(0.005)\n"
+        "    assert islands.dead_ranks(), 'death never detected'\n"
+        "    islands.heal()\n"
+        "    rec = None\n"
+        "    while rec is None and time.monotonic() < deadline:\n"
+        "        rec = islands.admit_pending(timeout=30)\n"
+        "        if rec is None:\n"
+        "            time.sleep(0.02)\n"
+        "    assert rec is not None, 'replacement never admitted'\n"
+        "assert islands.size() == 3, islands.size()\n"
+        "assert islands.membership_epoch() == 1\n"
+        f"open(os.path.join({str(outdir)!r}, "
+        "f'done-{islands.global_rank()}'), 'w')"
+        ".write(str(islands.size()))\n"
+        "islands.barrier(timeout=60)\n"
+        "islands.shutdown(unlink=False)\n"
+    )
+    env = dict(os.environ, PYTHONPATH=repo,
+               BFTPU_FAILURE_TIMEOUT_S="1.0",
+               BFTPU_LAUNCH_GRACE_S="60", BFTPU_MAX_RESPAWNS="1")
+    proc = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.run.launcher", "--islands", "3",
+         "--self-heal", "--job", f"selfheal{os.getpid()}", "--",
+         sys.executable, "-c", script],
+        env=env, capture_output=True, text=True, timeout=180, cwd=repo,
+    )
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-2000:])
+    assert "self-heal spawned replacement joiner" in proc.stderr
+    # survivors kept global ranks 0 and 2; the replacement is rank 3
+    done = sorted(p.name for p in outdir.iterdir())
+    assert done == ["done-0", "done-2", "done-3"], done
+    for p in outdir.iterdir():
+        assert p.read_text() == "3"
+
+
+def test_launcher_attach_scale_admits_extra_rank(tmp_path):
+    """``bftpu-run --attach JOB scale +1`` against a live islands run:
+    the control socket enqueues a joiner, the members admit it, and the
+    job finishes with 3 members in epoch 1."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    job = f"attach{os.getpid()}"
+    outdir = tmp_path
+    script = (
+        "import os, time\n"
+        "import numpy as np\n"
+        "from bluefog_tpu import islands\n"
+        "islands.init()\n"
+        "joiner = os.environ.get('BLUEFOG_ISLAND_JOINER') == '1'\n"
+        "islands.win_create(np.full(2, 1.0 * islands.global_rank()), 'at')\n"
+        "if not joiner:\n"
+        "    deadline = time.monotonic() + 90.0\n"
+        "    rec = None\n"
+        "    while rec is None and time.monotonic() < deadline:\n"
+        "        islands.win_put(islands.win_sync('at'), 'at')\n"
+        "        islands.win_update('at')\n"
+        "        rec = islands.admit_pending(timeout=60)\n"
+        "        if rec is None:\n"
+        "            time.sleep(0.02)\n"
+        "    assert rec is not None, 'scale request never arrived'\n"
+        "assert islands.size() == 3, islands.size()\n"
+        f"open(os.path.join({str(outdir)!r}, "
+        "f'done-{islands.global_rank()}'), 'w')"
+        ".write(str(islands.membership_epoch()))\n"
+        "islands.barrier(timeout=60)\n"
+        "islands.shutdown(unlink=False)\n"
+    )
+    env = dict(os.environ, PYTHONPATH=repo, BFTPU_LAUNCH_GRACE_S="60")
+    run = subprocess.Popen(
+        [sys.executable, "-m", "bluefog_tpu.run.launcher", "--islands", "2",
+         "--job", job, "--", sys.executable, "-c", script],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, cwd=repo,
+    )
+    try:
+        from bluefog_tpu.run import launcher as ln
+
+        sock_path = ln.control_sock_path(job)
+        deadline = time.monotonic() + 60.0
+        while not os.path.exists(sock_path) \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        time.sleep(1.0)  # let the members reach their gossip loop
+        att = subprocess.run(
+            [sys.executable, "-m", "bluefog_tpu.run.launcher",
+             "--attach", job, "scale", "+1"],
+            env=env, capture_output=True, text=True, timeout=30, cwd=repo,
+        )
+        assert att.returncode == 0, (att.stdout, att.stderr)
+        assert '"ok": true' in att.stdout.lower().replace("'", '"')
+        out, err = run.communicate(timeout=150)
+    except BaseException:
+        run.kill()
+        run.communicate()
+        raise
+    assert run.returncode == 0, (run.returncode, err[-2000:])
+    assert "spawned joiner" in err
+    done = sorted(p.name for p in outdir.iterdir())
+    assert done == ["done-0", "done-1", "done-2"], done
+    for p in outdir.iterdir():
+        assert p.read_text() == "1"  # everyone finished in epoch 1
