@@ -495,6 +495,18 @@ def main():
             print(json.dumps(jn), file=sys.stderr)
         except Exception as e:  # noqa: BLE001
             print(f"join phase failed: {e!r}", file=sys.stderr)
+    strag = None
+    if time.perf_counter() - t_start < budget_s:
+        try:
+            # adaptive-topology headline (docs/RESILIENCE.md "Adaptive
+            # topology"): slow one of 4 gossiping island ranks by 600 ms
+            # per step, measure the healthy ranks' pooled synchronous
+            # step p99 with the control loop on vs off
+            from recovery import measure_straggler
+            strag = measure_straggler(nprocs=4)
+            print(json.dumps(strag), file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"straggler phase failed: {e!r}", file=sys.stderr)
 
     headline = {
         "metric": "ResNet-50 images/sec/chip (neighbor_allreduce exp2)"
@@ -571,6 +583,13 @@ def main():
         # transfer + the first grown round
         headline["join_member_switch_range_ms"] = \
             jn["member_switch_range_ms"]
+    if strag is not None:
+        headline["straggler_p99_ms"] = strag["value"]
+        headline["straggler_metric"] = strag["metric"]
+        # same workload with BFTPU_ADAPTIVE=0: every healthy rank waits
+        # out the straggler to the hard cap — the on/off gap is the
+        # routing-around win (on must be strictly below off)
+        headline["straggler_p99_off_ms"] = strag["adaptive_off_p99_ms"]
     print(json.dumps(headline))
 
 

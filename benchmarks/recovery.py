@@ -204,8 +204,107 @@ def measure_join(nprocs: int = 4) -> dict:
     }
 
 
+def _straggler_worker(rank, size, steps):
+    """One synchronous-gossip rank for :func:`measure_straggler` — runs
+    under ``islands.spawn`` (auto-init'ed).  Per step: deposit, then
+    wait for a fresh deposit on every in-edge, counting an ABSORBED
+    edge (adaptive mode) as handled — the contract a synchronous
+    training step has with the gossip layer.  The chaos schedule slows
+    the last rank at its checkpoint, so in adaptive-off mode every
+    neighbor eats the straggler's nap (up to the 2 s hard cap); in
+    adaptive-on mode the ABSORB deadline and then the demotion bound
+    the wait.  Returns ``(rank, post-warmup step durations in s)``."""
+    from bluefog_tpu import islands, topology_util
+    from bluefog_tpu.resilience import chaos
+
+    islands.set_topology(topology_util.ExponentialTwoGraph(size))
+    islands.win_create(np.full(4, float(rank), np.float64), "st")
+    islands.barrier()
+    durs = []
+    for step in range(steps):
+        chaos.checkpoint(rank, "stbench")       # the straggler naps here
+        before = islands.get_win_version("st")
+        islands.win_put(islands.win_sync("st"), "st")
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 2.0:      # the no-adaptive hard cap
+            islands.win_update("st")
+            now_v = islands.get_win_version("st")
+            if set(now_v) != set(before):
+                break  # epoch switched mid-wait: edge set changed
+            absorbed = set(islands.win_absorbed("st"))
+            members = islands._ctx().members_global
+            if not {s for s, v in now_v.items()
+                    if v <= before.get(s, 0)
+                    and members[s] not in absorbed}:
+                break
+            time.sleep(0.002)
+        if step >= 5:  # warmup: cold pools, first chaos window edge
+            durs.append(time.monotonic() - t0)
+        islands.adaptive_step()
+        time.sleep(0.003)
+    return (rank, durs)
+
+
+def _pooled_p99_ms(durs) -> float:
+    durs = sorted(durs)
+    return durs[min(len(durs) - 1, int(round(0.99 * (len(durs) - 1))))] \
+        * 1000.0
+
+
+def _run_straggler_once(nprocs, steps, delay_s, adaptive_on) -> float:
+    from bluefog_tpu import islands
+    from bluefog_tpu.native import shm_native
+    from bluefog_tpu.resilience import chaos
+
+    job = f"strag{os.getpid()}{'a' if adaptive_on else 'o'}"
+    keys = ("BFTPU_ADAPTIVE", "BFTPU_EDGE_DEADLINE_S")
+    saved = {k: os.environ.get(k) for k in keys}
+    os.environ["BFTPU_ADAPTIVE"] = "1" if adaptive_on else "0"
+    os.environ["BFTPU_EDGE_DEADLINE_S"] = "0.2"
+    chaos.schedule_slow(os.environ, rank=nprocs - 1, step=5,
+                        delay_s=delay_s)
+    try:
+        res = islands.spawn(_straggler_worker, nprocs, job=job,
+                            timeout=300.0, args=(steps,))
+    finally:
+        chaos.clear_schedule()
+        shm_native.unlink_all(job, ["st"])
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    healthy = [d for rank, ds in res if rank != nprocs - 1 for d in ds]
+    return _pooled_p99_ms(healthy)
+
+
+def measure_straggler(nprocs: int = 4, steps: int = 30,
+                      delay_s: float = 0.6) -> dict:
+    """One rank sleeps ``delay_s`` per round (gray failure: heartbeats
+    keep flowing) while the others run synchronous gossip steps; return
+    the metric dict with ``value`` = pooled healthy-rank step p99 in ms
+    with the adaptive control loop ON (bench.py's ``straggler_p99_ms``
+    headline), plus the adaptive-OFF p99 for the contrast.  ON is
+    bounded by the edge deadline (ABSORB) and then by the demotion that
+    drops the straggler's edges; OFF eats the nap every round."""
+    on_ms = _run_straggler_once(nprocs, steps, delay_s, adaptive_on=True)
+    off_ms = _run_straggler_once(nprocs, steps, delay_s, adaptive_on=False)
+    return {
+        "metric": f"healthy-rank synchronous gossip step p99 with one "
+                  f"{delay_s * 1000:.0f} ms straggler "
+                  f"(exp2, {nprocs} procs, shm mailbox, adaptive on)",
+        "value": round(on_ms, 1),
+        "unit": "ms",
+        "adaptive_off_p99_ms": round(off_ms, 1),
+        "straggler_delay_ms": round(delay_s * 1000.0, 1),
+        "steps": steps,
+        "ranks_pooled": nprocs - 1,
+    }
+
+
 if __name__ == "__main__":
     import json
 
     print(json.dumps({"recovery": measure_recovery(),
-                      "join": measure_join()}))
+                      "join": measure_join(),
+                      "straggler": measure_straggler()}))

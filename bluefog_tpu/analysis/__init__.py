@@ -25,6 +25,11 @@ consumers (CLI, pytest, CI):
 - **trace** (:mod:`.trace_rules`) — distributed-trace buffers: per-rank
   span nesting, cross-rank flow-endpoint resolution, and clock blocks
   within the min-RTT estimator's own error bound;
+- **adaptive** (:mod:`.adaptive_rules`) — demoted (straggler-capped)
+  topologies stay doubly stochastic and mixing with the straggler
+  retained at degree one, restores round-trip to the pre-demotion W,
+  and the driven EdgeHealth machine admits no demote/promote cycle
+  shorter than the hysteresis floor;
 - the **fixture corpus** (:mod:`.fixtures`) — seeded bugs proving every
   rule fires.
 
@@ -45,6 +50,7 @@ from bluefog_tpu.analysis.engine import (  # noqa: F401
 
 # importing the family modules populates ``registry``
 from bluefog_tpu.analysis import (  # noqa: F401
+    adaptive_rules,
     epoch_rules,
     fixtures,
     hlo_corpus,

@@ -23,9 +23,10 @@ from typing import Callable, Dict, List
 from bluefog_tpu import topology_util as tu
 from bluefog_tpu.core.plan import compile_plan, plan_from_neighbor_lists
 
-from bluefog_tpu.resilience.healing import heal_topology
+from bluefog_tpu.resilience.healing import demote_topology, heal_topology
 
 from bluefog_tpu.analysis import (
+    adaptive_rules,
     epoch_rules,
     hlo_rules,
     plan_rules,
@@ -223,6 +224,67 @@ def _epoch_switch_unbalanced_ledger() -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# adaptive fixtures: botched demotions + a flapping schedule under the floor
+# ---------------------------------------------------------------------------
+
+
+def _demoted_straggler_excised() -> List[Finding]:
+    """A demotion that dropped the straggler from the member set — the
+    death-by-another-name bug: its pending slot mass has nowhere to
+    drain and every neighbor averages in a vanished rank."""
+    demoted = demote_topology(tu.ExponentialTwoGraph(8), [3])
+    lied = dataclasses.replace(
+        demoted, survivors=tuple(r for r in demoted.survivors if r != 3))
+    return adaptive_rules.check_straggler_member(
+        lied, "exp2@8-slow[3][straggler-excised]")
+
+
+def _demoted_degree_cap_violated() -> List[Finding]:
+    """A demotion that forgot to cut one of the straggler's edges: the
+    straggler keeps two neighbors, so it still sits on a second rank's
+    critical path and the convoy persists."""
+    demoted = demote_topology(tu.ExponentialTwoGraph(8), [3])
+    H = demoted.topology.copy()
+    v = demoted.to_local[3]
+    extra = next(u for u in H.nodes
+                 if u != v and not H.has_edge(v, u))
+    H.add_edge(v, extra)
+    H.add_edge(extra, v)
+    lied = dataclasses.replace(demoted, topology=H)
+    return adaptive_rules.check_straggler_capped(
+        lied, "exp2@8-slow[3][degree-2]")
+
+
+def _demoted_not_doubly_stochastic() -> List[Finding]:
+    """A demoted plan whose Metropolis–Hastings re-weighting was skipped
+    for one edge (weight doubled): the adaptively produced W stops being
+    doubly stochastic, so gossip under it drifts off the average."""
+    demoted = demote_topology(tu.ExponentialTwoGraph(8), [3])
+    cls = demoted.plan.classes[0]
+    rw = list(cls.recv_weights)
+    idx = next(i for i, w in enumerate(rw) if w != 0.0)
+    rw[idx] *= 2.0
+    bad = dataclasses.replace(cls, recv_weights=tuple(rw))
+    mutated = dataclasses.replace(
+        demoted.plan, classes=(bad,) + demoted.plan.classes[1:])
+    return plan_rules.check_mixing_stochastic(
+        mutated, "exp2@8-slow[3][skipped-mh]", expect_column=True)
+
+
+def _adaptive_flap_below_floor() -> List[Finding]:
+    """A transition log where one peer demotes and promotes 0.2 s apart
+    under a 1 s hysteresis floor — the epoch-thrash signature the floor
+    exists to forbid."""
+    log = [
+        {"t": 0.0, "peer": 3, "frm": "alive", "to": "suspect"},
+        {"t": 0.2, "peer": 3, "frm": "suspect", "to": "alive"},
+        {"t": 1.5, "peer": 3, "frm": "alive", "to": "suspect"},
+    ]
+    return adaptive_rules.check_hysteresis(
+        log, floor_s=1.0, label="fixture[flap-0.2s]")
+
+
+# ---------------------------------------------------------------------------
 # protocol fixtures: broken seqlock/collect/barrier variants + bad traces
 # ---------------------------------------------------------------------------
 
@@ -376,6 +438,11 @@ FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
     "grown-reuses-dead-rank": _grown_reuses_dead_rank,
     "grown-not-doubly-stochastic": _grown_not_doubly_stochastic,
     "epoch-switch-unbalanced-ledger": _epoch_switch_unbalanced_ledger,
+    # adaptive family: botched demotions + a sub-floor flapping schedule
+    "adaptive-straggler-excised": _demoted_straggler_excised,
+    "adaptive-degree-cap-violated": _demoted_degree_cap_violated,
+    "adaptive-demoted-not-doubly-stochastic": _demoted_not_doubly_stochastic,
+    "adaptive-flap-below-floor": _adaptive_flap_below_floor,
     "dead-writer-lost-mass-drain": lambda: _model_fixture(
         seqlock_model.dead_writer_drain_model(deposits=2,
                                               account_wiped=False)),

@@ -45,10 +45,11 @@ import numpy as np
 
 from bluefog_tpu import topology_util
 from bluefog_tpu.native import shm_native
+from bluefog_tpu.resilience import adaptive as _adaptive
 from bluefog_tpu.resilience import degraded as _degraded
 from bluefog_tpu.resilience import healing as _healing
 from bluefog_tpu.resilience import join as _join
-from bluefog_tpu.resilience.detector import FailureDetector
+from bluefog_tpu.resilience.detector import EDGE_ALIVE, FailureDetector
 from bluefog_tpu.telemetry import registry as _telemetry
 from bluefog_tpu.timeline import timeline_context
 from bluefog_tpu.tracing import tracer as _tracing
@@ -70,6 +71,7 @@ __all__ = [
     "win_accumulate",
     "win_get",
     "win_update",
+    "win_absorbed",
     "win_update_then_collect",
     "win_sync",
     "win_mutex",
@@ -90,6 +92,9 @@ __all__ = [
     "membership_epoch",
     "join",
     "admit_pending",
+    "adaptive_step",
+    "adaptive_policy",
+    "demoted_ranks",
     "spawn",
 ]
 
@@ -119,6 +124,15 @@ class _IslandWindow:
         # the word unchanged consumed no NEW deposit on that edge, so no
         # duplicate flow arrow is recorded
         self._trace_seen: Dict[int, int] = {}
+        # adaptive edge-health probe state: slot -> (version, time the
+        # version last CHANGED, miss already counted for this gap) — an
+        # unchanged version past the edge deadline is ONE deadline miss
+        # per gap (resilience/adaptive.py)
+        self._edge_seen: Dict[int, Tuple[int, float, bool]] = {}
+        # GLOBAL ranks the most recent combine dropped via the
+        # round-local ABSORB (read back by win_absorbed: a synchronous
+        # caller treats an absorbed edge as handled for this round)
+        self._last_absorbed: Tuple[int, ...] = ()
         # writer-side deposit tally per destination, and the version the
         # creation seed left in each slot: together they let heal()
         # settle the ledger for a dead peer (adopt its lost writer-side
@@ -178,12 +192,42 @@ class _IslandContext:
         self.epoch = 0
         self.global_rank = rank_
         self.members_global: Tuple[int, ...] = tuple(range(size_))
+        # adaptive topology (resilience/adaptive.py): the edge-health
+        # policy OUTLIVES epoch switches (it is keyed by global rank and
+        # holds the hysteresis clocks), unlike the per-epoch detector.
+        # ``demoted`` is the degree-capped global-rank set of the current
+        # reweight record; ``base_edges`` the pre-demotion global edge
+        # list a promote restores.
+        self.adaptive: Optional[_adaptive.AdaptivePolicy] = (
+            _adaptive.AdaptivePolicy() if _adaptive.adaptive_enabled()
+            else None)
+        self.demoted: set = set()
+        self.base_edges: Optional[List[Tuple[int, int]]] = None
+        _attach_edge_health(self)
 
 
 def _trivial_graph() -> nx.DiGraph:
     g = nx.DiGraph()
     g.add_node(0)
     return g
+
+
+def _attach_edge_health(ctx: "_IslandContext") -> None:
+    """Wire the (epoch-persistent) edge-health machine into the
+    (per-epoch) failure detector, translating the detector's local
+    ranks to the machine's global ids — death declarations must reach
+    the machine (DEAD outranks SUSPECT, and is never floor-delayed)."""
+    if ctx.adaptive is None:
+        return
+    members = ctx.members_global
+    ctx.detector.edge_health = ctx.adaptive.health
+    ctx.detector.to_peer = (
+        lambda l: members[l] if 0 <= l < len(members) else l)
+
+
+def _peer_global(ctx: "_IslandContext", local: int) -> int:
+    m = ctx.members_global
+    return m[local] if 0 <= local < len(m) else local
 
 
 _context: Optional[_IslandContext] = None
@@ -504,6 +548,16 @@ def _switch_epoch(ctx: "_IslandContext", rec: dict) -> None:
     reg = _telemetry.get_registry()
     tr = _tracing.get_tracer()
     t0 = time.perf_counter_ns()
+    if rec.get("reweight"):
+        # QUIESCE before probing: an adaptive reweight switches a fleet
+        # where every member is alive and mid-gossip — a deposit landing
+        # after my pending-probe but before the peer switches would
+        # vanish from the ledger.  Barriering the OLD epoch first orders
+        # every member's last old-epoch write before every member's
+        # probe, so the switch-point ledger balances deterministically.
+        # (The join/death path cannot do this: its old epoch may contain
+        # a corpse that will never arrive.)
+        ctx.shm_job.barrier()
     saved: Dict[str, Tuple[np.ndarray, float]] = {}
     for name, w in ctx.windows.items():
         if reg.enabled:
@@ -518,6 +572,7 @@ def _switch_epoch(ctx: "_IslandContext", rec: dict) -> None:
                     new_epoch=int(rec["epoch"]),
                     global_rank=ctx.global_rank,
                     joined=list(rec.get("joined", ())),
+                    demoted=list(rec.get("demoted", ())),
                     **_ledger_totals(reg))
     ctx.detector.stop()
     for w in ctx.windows.values():
@@ -536,10 +591,29 @@ def _switch_epoch(ctx: "_IslandContext", rec: dict) -> None:
     ctx.topology = _join.record_graph(rec)
     ctx.dead = set()
     ctx.healed = None
+    # reweight records carry the adaptive state forward; any other kind
+    # (a join grant re-splices the graph) resets it — the persistent
+    # edge-health machine will simply re-demote a still-slow rank
+    old_demoted = set(ctx.demoted)
+    ctx.demoted = set(int(g) for g in rec.get("demoted", ()))
+    if ctx.adaptive is not None and rec.get("reweight"):
+        # start the commit floor for every peer whose standing changed,
+        # and adopt the committer's promote verdicts: a non-anchor's
+        # machine was starved of observations during the demotion and
+        # would otherwise re-demote on its stale SUSPECT state
+        changed = (old_demoted ^ ctx.demoted) \
+            | set(int(g) for g in rec.get("promoted", ()))
+        ctx.adaptive.note_epoch_change(changed)
+        for g in rec.get("promoted", ()):
+            if int(g) != ctx.global_rank:
+                ctx.adaptive.health.absolve(int(g))
+    ctx.base_edges = ([(int(u), int(v)) for u, v in rec["base_edges"]]
+                      if rec.get("base_edges") else None)
     ctx.windows = {}
     ctx.created_names = set()
     ctx.shm_job = shm_native.make_job(ejob, new_local, m)
     ctx.detector = FailureDetector(ctx.shm_job, new_local, m).start()
+    _attach_edge_health(ctx)
     ctx.shm_job.barrier()  # every new-epoch member (joiners included)
     for wmeta in sorted(rec["windows"], key=lambda w: w["name"]):
         name = wmeta["name"]
@@ -721,6 +795,166 @@ def join(job: Optional[str] = None, timeout: Optional[float] = None):
     if tr.enabled:
         tr.instant("join_complete", aux=grant.epoch)
     return grant
+
+
+# ---------------------------------------------------------------------------
+# adaptive topology: the straggler demote/promote control loop
+# (resilience/adaptive.py; docs/RESILIENCE.md "Adaptive topology")
+# ---------------------------------------------------------------------------
+
+
+def adaptive_policy() -> Optional[_adaptive.AdaptivePolicy]:
+    """This rank's adaptive edge-health policy, or None when
+    ``BFTPU_ADAPTIVE`` is off."""
+    return _ctx().adaptive
+
+
+def demoted_ranks() -> Tuple[int, ...]:
+    """Sorted global ranks currently demoted (degree-capped) by the
+    adaptive topology — members, not corpses: they still gossip through
+    their anchor edge."""
+    return tuple(sorted(_ctx().demoted))
+
+
+def _members_graph_global(ctx: "_IslandContext") -> nx.DiGraph:
+    """The CURRENT epoch topology over ALL members (demoted included),
+    in global rank labels — the base a demote caps or a promote
+    restores."""
+    G = nx.DiGraph()
+    G.add_nodes_from(sorted(ctx.members_global))
+    for u, v in ctx.topology.edges:
+        if u != v:
+            G.add_edge(_peer_global(ctx, u), _peer_global(ctx, v))
+    return G
+
+
+def _is_anchor(ctx: "_IslandContext", g: int) -> bool:
+    """Whether this rank is ``g``'s anchor in the demoted topology —
+    the ONLY member still observing g's edge, hence the only member
+    whose edge-health machine can witness the recovery (everyone else
+    stopped probing g when the demote dropped their edges)."""
+    if g not in ctx.members_global:
+        return False
+    lg = ctx.members_global.index(g)
+    nbrs = set(ctx.topology.successors(lg)) | set(ctx.topology.predecessors(lg))
+    return ctx.rank in nbrs
+
+
+def _commit_reweight(ctx: "_IslandContext", board, demote=(), promote=()):
+    """Compute the deterministic reweight record and race it onto the
+    board (first observer wins; the rest adopt the committed record)."""
+    base = ctx.base_edges
+    if base is None:
+        G0 = _members_graph_global(ctx)
+        base = sorted((int(u), int(v)) for u, v in G0.edges)
+    baseG = nx.DiGraph()
+    baseG.add_nodes_from(sorted(ctx.members_global))
+    baseG.add_edges_from(base)
+    new_demoted = (set(ctx.demoted) | set(demote)) - set(promote)
+    if new_demoted:
+        healed = _healing.demote_topology(baseG, sorted(new_demoted))
+    else:
+        # full restore: heal with an empty dead set re-symmetrizes and
+        # MH re-weights the base graph through the same pipeline
+        healed = _healing.heal_topology(baseG, [])
+    reg = _telemetry.get_registry()
+    rec = board.commit_reweight(
+        committer=ctx.global_rank, prev_epoch=ctx.epoch,
+        members=[int(m) for m in healed.to_global],
+        edges=list(healed.topology.edges),
+        windows=_windows_meta(ctx), associated_p=ctx.associated_p,
+        demoted=sorted(new_demoted), promoted=sorted(promote),
+        base_edges=base)
+    if rec is not None and not rec.get("reweight"):
+        return None  # a raced JOIN grant won this epoch; retry next tick
+    if (rec is not None and reg.enabled
+            and int(rec["sponsor"]) == ctx.global_rank):
+        which = "demote" if demote else "promote"
+        reg.counter(f"adaptive.{which}s_committed").inc()
+        reg.journal(f"adaptive_{which}", epoch=int(rec["epoch"]),
+                    demoted=list(rec.get("demoted", ())),
+                    promoted=list(rec.get("promoted", ())),
+                    committer=ctx.global_rank)
+    return rec
+
+
+def adaptive_step():
+    """One tick of the adaptive-topology control loop: call at the
+    round cadence on EVERY member (right after a combine is the natural
+    spot).  No-op unless ``BFTPU_ADAPTIVE`` is on.
+
+    Three things can happen, at most one per tick:
+
+    1. a reweight epoch committed by another member is observed (cheap
+       epoch-word probe) and this rank switches into it;
+    2. an in-neighbor the edge-health machine holds SUSPECT is DEMOTED:
+       any observer commits the deterministic degree-capped topology
+       (:func:`~bluefog_tpu.resilience.healing.demote_topology`,
+       first-wins) and switches;
+    3. a demoted rank whose machine transitioned back to ALIVE — only
+       its ANCHOR still observes it — is PROMOTED: the anchor commits
+       the restored base topology and switches.
+
+    Returns the epoch record switched through, or None.  Flapping
+    cannot thrash epochs: the machine's hysteresis floor
+    (``BFTPU_DEMOTE_FLOOR_S``) lower-bounds the time between its own
+    transitions, and demote/promote commits only fire ON a transition's
+    standing state.  Demotions are additionally capped to a MINORITY of
+    the membership (longest-SUSPECT first) — every straggler needs a
+    healthy anchor, and no misattribution cascade can demote the fleet
+    out from under itself (at np=2 the cap is zero: ABSORB alone
+    bounds the rounds there).
+    """
+    ctx = _ctx()
+    pol = ctx.adaptive
+    if pol is None:
+        return None
+    board = _join.MembershipBoard(ctx.base_job)
+    # 1. observe: someone committed an epoch I have not switched into
+    if shm_native.membership_epoch(ctx.base_job) > ctx.epoch:
+        rec = board.epoch_record(ctx.epoch + 1)
+        if rec is not None and rec.get("reweight"):
+            _switch_epoch(ctx, rec)
+            return dict(rec)
+        return None  # a join grant: admit_pending's business
+    # 2. demote: a live, not-yet-demoted member gone SUSPECT
+    suspects = pol.health.suspects()
+    if suspects:
+        cand = sorted(
+            g for g in suspects
+            if g in ctx.members_global and g not in ctx.demoted
+            and g != ctx.global_rank
+            and ctx.members_global.index(g) not in ctx.dead
+            and pol.epoch_floor_open(g))
+        if cand:
+            # never demote past a minority: every straggler needs a
+            # healthy anchor and a majority-healthy core keeps the
+            # demoted graph mixing — this is also the terminal guard
+            # against a convoy misattribution walking the fleet into
+            # "every member is a straggler".  Longest-SUSPECT first:
+            # under contention the persistently slow rank wins the slot
+            # over a transient suspect.
+            room = (len(ctx.members_global) - 1) // 2 - len(ctx.demoted)
+            cand.sort(key=lambda g: -pol.health.time_in_state(g))
+            cand = sorted(cand[:max(0, room)])
+        if cand:
+            rec = _commit_reweight(ctx, board, demote=cand)
+            if rec is not None:
+                _switch_epoch(ctx, rec)
+                return dict(rec)
+            return None
+    # 3. promote: an anchored straggler proved itself ALIVE again
+    if ctx.demoted:
+        cand = sorted(
+            g for g in ctx.demoted
+            if pol.health.state(g) == EDGE_ALIVE and _is_anchor(ctx, g)
+            and pol.epoch_floor_open(g))
+        if cand:
+            rec = _commit_reweight(ctx, board, promote=cand)
+            if rec is not None:
+                _switch_epoch(ctx, rec)
+                return dict(rec)
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -1172,6 +1406,59 @@ def win_get(name: str, src_weights: WeightDict = None) -> bool:
     return True
 
 
+def _adaptive_probe(ctx: "_IslandContext", win: _IslandWindow,
+                    nbrs: Sequence[int]) -> Tuple[int, ...]:
+    """Probe each in-edge's slot version (a monotone deposit count) and
+    feed the edge-health policy: a changed version is a fresh deposit
+    (clean observation + a gap sample for the pooled baseline), an
+    unchanged one past the edge deadline is a miss.  Returns the local
+    ranks whose edges missed — the combine absorbs them for this round.
+
+    One ``read_version`` word per edge per combine; transports without
+    the surface opt out (no probe, no misses)."""
+    pol = ctx.adaptive
+    rv = getattr(win.shm, "read_version", None)
+    if rv is None:
+        return ()
+    now = time.monotonic()
+    seen = win._edge_seen
+    stale: List[int] = []
+    for s in nbrs:
+        slot = win.slot_of[ctx.rank][s]
+        try:
+            ver = int(rv(slot, src=s))
+        except Exception:  # noqa: BLE001 - health probing must never break the op
+            continue
+        prev = seen.get(slot)
+        if prev is None or ver != prev[0]:
+            if prev is not None:
+                # the completed gap is the observation unit: clean only
+                # if it made the deadline (a missed gap already counted
+                # its one miss mid-gap — prev[2])
+                pol.note_fresh(_peer_global(ctx, s), now - prev[1],
+                               clean=not prev[2])
+            seen[slot] = (ver, now, False)
+        else:
+            d = pol.gap_deadline_s()
+            age = now - prev[1]
+            if d is None or age <= d:
+                continue
+            if not prev[2]:
+                # ONE miss per stale gap, never one per poll: a
+                # synchronous caller polling at ms cadence would turn a
+                # single marginal gap into a full SUSPECT streak, and
+                # the convoy behind a straggler (blocked ranks stop
+                # depositing too) would demote innocents.  A persistent
+                # straggler misses on EVERY gap and still builds the
+                # streak; a rank silent forever is the heartbeat
+                # detector's jurisdiction — ABSORB keeps the round
+                # bounded meanwhile.
+                pol.note_stale(_peer_global(ctx, s), age)
+                seen[slot] = (prev[0], prev[1], True)
+            stale.append(s)
+    return tuple(stale)
+
+
 def _resolve_update_weights(win: _IslandWindow, self_weight, neighbor_weights):
     nbrs = win.in_neighbors
     if neighbor_weights is not None:
@@ -1226,6 +1513,29 @@ def win_update(
         # after healing, dead in-neighbors are absent from nw: their slots
         # were force-drained and must not be combined (or even locked)
         nbrs = [s for s in win.in_neighbors if s in nw]
+        win._last_absorbed = ()
+        if ctx.adaptive is not None and nbrs:
+            # round-local ABSORB on deadline-missed edges: a stale edge
+            # is dropped from THIS combine only — its slot keeps its
+            # mass (pending; collected once the straggler deposits), and
+            # for a convex row the dropped weight moves to self so the
+            # row total is unchanged.  Push-sum collect rows (all-ones)
+            # are not convex: there the plain drop is the conserving
+            # move (doubling the self share would mint mass).
+            stale = _adaptive_probe(ctx, win, nbrs)
+            if stale:
+                convex = abs(sw + sum(nw.values()) - 1.0) <= 1e-6
+                dropped = 0.0
+                for s in stale:
+                    dropped += nw.pop(s)
+                if convex:
+                    sw += dropped
+                nbrs = [s for s in nbrs if s in nw]
+                win._last_absorbed = tuple(
+                    sorted(_peer_global(ctx, s) for s in stale))
+                if reg.enabled:
+                    reg.counter("adaptive.weight_absorbed").add(
+                        dropped if convex else float(len(stale)))
         consumes = None
         if ttok is not None:
             # peek BEFORE the combine: collect (reset) may recycle the
@@ -1367,6 +1677,15 @@ def win_update_then_collect(name: str, require_mutex: bool = False):
                           reset=True)
 
 
+def win_absorbed(name: str) -> Tuple[int, ...]:
+    """GLOBAL ranks whose edges the most recent :func:`win_update` on
+    ``name`` dropped via the round-local ABSORB (deadline-missed
+    in-edges).  A synchronous caller waiting for every in-edge to turn
+    fresh treats an absorbed edge as handled for the round — that is
+    exactly the bound the adaptive deadline buys."""
+    return _win(name)._last_absorbed
+
+
 def win_sync(name: str):
     """My current tensor (or pytree, for fused windows) without combining
     (reference ``bf.win_sync``-style read of the window copy [U])."""
@@ -1409,6 +1728,8 @@ def _mutex_acquire_deadline(ctx: "_IslandContext", r: int) -> None:
         if ctx.detector.dead_ranks() - ctx.dead:
             heal()
 
+    pol = ctx.adaptive
+    t0 = time.monotonic() if pol is not None else 0.0
     try:
         _degraded.with_deadline(
             lambda budget: ctx.shm_job.mutex_acquire(r, timeout=budget),
@@ -1416,6 +1737,11 @@ def _mutex_acquire_deadline(ctx: "_IslandContext", r: int) -> None:
             on_timeout=on_timeout)
     except TypeError:
         ctx.shm_job.mutex_acquire(r)
+    if pol is not None and r != ctx.rank and r not in ctx.dead:
+        # the convoy signal: a straggler asleep INSIDE its critical
+        # section stalls this acquire long past the healthy-cadence
+        # baseline (acquires are never CLEAN evidence — see adaptive.py)
+        pol.note_acquire(_peer_global(ctx, r), time.monotonic() - t0)
 
 
 def win_associated_p(name: str) -> float:

@@ -30,7 +30,10 @@ import jax.numpy as jnp
 from jax import lax
 
 from bluefog_tpu.parallel._util import pvary, resolve_axis_size
-from bluefog_tpu.parallel.tensor_parallel import reduce_from_tp_region
+from bluefog_tpu.parallel.tensor_parallel import (
+    copy_to_tp_region,
+    reduce_from_tp_region,
+)
 
 __all__ = ["pipeline_apply", "stack_stage_params", "PP_AXIS"]
 
@@ -67,6 +70,13 @@ def pipeline_apply(
     """
     n = int(resolve_axis_size(axis_name, axis_size))
     idx = lax.axis_index(axis_name)
+    # the replicated batch enters the pp-varying region through the f
+    # operator (identity/pvary forward, psum backward): each stage's
+    # transpose contributes only its masked share of the input cotangent
+    # (zero off stage 0), and the psum reassembles a statically
+    # replicated dx — without it, shard_map's rep checker cannot infer
+    # replication for a grad-of-pipeline output typed P()
+    x = copy_to_tp_region(x, axis_name)
     total = x.shape[0]
     if total % num_microbatches:
         raise ValueError(

@@ -8,7 +8,10 @@ words; tcp: coordinator-mediated leases), **topology healing** that
 re-derives a doubly-stochastic survivor topology and recompiles the
 shift-class plan when ranks die, **degraded-step semantics** (deadlines
 with retry/backoff; mass-conserving weight renormalization on neighbor
-loss, so push-sum stays correct), and a **fault-injection harness**
+loss, so push-sum stays correct), **adaptive topology** (a three-state
+gray-failure machine over per-edge deadline misses that demotes a
+straggler to one anchor edge — and promotes it back — without ever
+declaring it dead; adaptive.py), and a **fault-injection harness**
 for the chaos e2e tests.
 
 Push-sum-style algorithms are provably robust on time-varying directed
@@ -17,11 +20,24 @@ these modules make the runtime tolerate them too.  See
 docs/RESILIENCE.md for the full contract.
 """
 
+from bluefog_tpu.resilience.adaptive import (
+    AdaptivePolicy,
+    adaptive_enabled,
+    edge_deadline_factor,
+    edge_deadline_floor_s,
+)
 from bluefog_tpu.resilience.detector import (
+    EDGE_ALIVE,
+    EDGE_DEAD,
+    EDGE_SUSPECT,
+    EdgeHealth,
     FailureDetector,
     PeerTimeoutError,
+    demote_floor_s,
     failure_timeout_s,
     heartbeat_interval_s,
+    promote_clean,
+    suspect_misses,
 )
 from bluefog_tpu.resilience.degraded import (
     DeadlineExceeded,
@@ -31,6 +47,7 @@ from bluefog_tpu.resilience.degraded import (
 )
 from bluefog_tpu.resilience.healing import (
     HealedTopology,
+    demote_topology,
     grow_topology,
     heal_topology,
     healed_weight_matrix,
@@ -48,11 +65,23 @@ __all__ = [
     "PeerTimeoutError",
     "failure_timeout_s",
     "heartbeat_interval_s",
+    "EdgeHealth",
+    "EDGE_ALIVE",
+    "EDGE_SUSPECT",
+    "EDGE_DEAD",
+    "suspect_misses",
+    "promote_clean",
+    "demote_floor_s",
+    "AdaptivePolicy",
+    "adaptive_enabled",
+    "edge_deadline_floor_s",
+    "edge_deadline_factor",
     "DeadlineExceeded",
     "op_deadline_s",
     "renormalize_weights",
     "with_deadline",
     "HealedTopology",
+    "demote_topology",
     "grow_topology",
     "heal_topology",
     "healed_weight_matrix",

@@ -15,9 +15,12 @@ Faults are injected two ways:
   **gray failures** (``BFTPU_CHAOS_SUSPEND_RANK`` /
   ``BFTPU_CHAOS_SUSPEND_STEP`` / ``BFTPU_CHAOS_SUSPEND_S``: SIGSTOP
   past the heartbeat timeout, then SIGCONT — see :func:`suspend_self`)
-  and **join admissions** (``BFTPU_CHAOS_JOIN_RANK`` /
-  ``BFTPU_CHAOS_JOIN_STEP``: the rank calls
-  ``islands.admit_pending()`` at the scheduled step).
+  **stragglers** (``BFTPU_CHAOS_SLOW_RANK`` / ``BFTPU_CHAOS_SLOW_STEP``
+  / ``BFTPU_CHAOS_SLOW_S`` / ``BFTPU_CHAOS_SLOW_STOP``: a main-thread
+  sleep at every checkpoint from the scheduled step on, heartbeats
+  unimpaired — see :func:`schedule_slow`), and **join admissions**
+  (``BFTPU_CHAOS_JOIN_RANK`` / ``BFTPU_CHAOS_JOIN_STEP``: the rank
+  calls ``islands.admit_pending()`` at the scheduled step).
 
 Mailbox corruption for protocol tests goes through
 :func:`corrupt_chunk` on a :class:`~bluefog_tpu.native.shm_native.
@@ -43,6 +46,7 @@ __all__ = [
     "schedule_kill",
     "schedule_join",
     "schedule_suspend",
+    "schedule_slow",
     "clear_schedule",
     "corrupt_chunk",
 ]
@@ -55,10 +59,15 @@ _JOIN_STEP = "BFTPU_CHAOS_JOIN_STEP"
 _SUSPEND_RANK = "BFTPU_CHAOS_SUSPEND_RANK"
 _SUSPEND_STEP = "BFTPU_CHAOS_SUSPEND_STEP"
 _SUSPEND_S = "BFTPU_CHAOS_SUSPEND_S"
+_SLOW_RANK = "BFTPU_CHAOS_SLOW_RANK"
+_SLOW_STEP = "BFTPU_CHAOS_SLOW_STEP"
+_SLOW_S = "BFTPU_CHAOS_SLOW_S"
+_SLOW_STOP = "BFTPU_CHAOS_SLOW_STOP"
 
 _ALL_KEYS = (_KILL_RANK, _KILL_STEP, _DELAY_S,
              _JOIN_RANK, _JOIN_STEP,
-             _SUSPEND_RANK, _SUSPEND_STEP, _SUSPEND_S)
+             _SUSPEND_RANK, _SUSPEND_STEP, _SUSPEND_S,
+             _SLOW_RANK, _SLOW_STEP, _SLOW_S, _SLOW_STOP)
 
 
 def kill(pid: int) -> None:
@@ -136,6 +145,23 @@ def schedule_suspend(env: dict, rank: int, step: int,
     return env
 
 
+def schedule_slow(env: dict, rank: int, step: int, delay_s: float,
+                  stop: Optional[int] = None) -> dict:
+    """Publish a STRAGGLER schedule: rank ``rank`` sleeps ``delay_s``
+    seconds in its MAIN thread at every matching checkpoint from step
+    ``step`` on (until step ``stop``, exclusive, when given — the
+    recovery scenario).  Unlike :func:`schedule_suspend` the heartbeat
+    thread keeps beating throughout, so the failure detector never
+    declares the rank dead: this is the gray failure — slow but
+    responsive — that only the adaptive edge-health machine catches."""
+    env[_SLOW_RANK] = str(int(rank))
+    env[_SLOW_STEP] = str(int(step))
+    env[_SLOW_S] = str(float(delay_s))
+    if stop is not None:
+        env[_SLOW_STOP] = str(int(stop))
+    return env
+
+
 def clear_schedule() -> None:
     """Scrub EVERY chaos key from the calling process's environment —
     kill, join, and suspend schedules alike (a stale key would replay
@@ -160,7 +186,7 @@ def checkpoint(rank: int, tag: str = "step") -> None:
     way)."""
     env = os.environ
     if (_KILL_RANK not in env and _JOIN_RANK not in env
-            and _SUSPEND_RANK not in env):
+            and _SUSPEND_RANK not in env and _SLOW_RANK not in env):
         return
     delay = env.get(_DELAY_S)
     if delay:
@@ -168,6 +194,10 @@ def checkpoint(rank: int, tag: str = "step") -> None:
     key = (int(rank), tag)
     n = _counters.get(key, 0) + 1
     _counters[key] = n
+    if _matches(env.get(_SLOW_RANK), rank) \
+            and n >= int(env.get(_SLOW_STEP, "1")) \
+            and (_SLOW_STOP not in env or n < int(env[_SLOW_STOP])):
+        time.sleep(float(env.get(_SLOW_S, "0.5")))
     if _matches(env.get(_SUSPEND_RANK), rank) \
             and n == int(env.get(_SUSPEND_STEP, "1")):
         suspend_self(float(env.get(_SUSPEND_S, "2.5")))

@@ -40,8 +40,15 @@ from bluefog_tpu.tracing import tracer as _tracing
 __all__ = [
     "PeerTimeoutError",
     "FailureDetector",
+    "EdgeHealth",
+    "EDGE_ALIVE",
+    "EDGE_SUSPECT",
+    "EDGE_DEAD",
     "heartbeat_interval_s",
     "failure_timeout_s",
+    "suspect_misses",
+    "promote_clean",
+    "demote_floor_s",
 ]
 
 
@@ -77,6 +84,196 @@ def failure_timeout_s() -> float:
         return 2.0
 
 
+def suspect_misses() -> int:
+    """Consecutive deadline-missed deposit gaps (one miss per stale
+    gap, however long — see ``islands._adaptive_probe``) before
+    ALIVE -> SUSPECT (``BFTPU_SUSPECT_MISSES``)."""
+    try:
+        return max(1, int(os.environ.get("BFTPU_SUSPECT_MISSES", "3")))
+    except ValueError:
+        return 3
+
+
+def promote_clean() -> int:
+    """Consecutive clean (on-deadline) observations before a SUSPECT
+    rank is promoted back to ALIVE (``BFTPU_PROMOTE_CLEAN``)."""
+    try:
+        return max(1, int(os.environ.get("BFTPU_PROMOTE_CLEAN", "5")))
+    except ValueError:
+        return 5
+
+
+def demote_floor_s() -> float:
+    """Hysteresis floor: minimum seconds between consecutive edge-state
+    transitions for one peer (``BFTPU_DEMOTE_FLOOR_S``) — no
+    demote/promote cycle can be shorter, so a flapping rank cannot
+    thrash membership epochs."""
+    try:
+        return float(os.environ.get("BFTPU_DEMOTE_FLOOR_S", "1.0"))
+    except ValueError:
+        return 1.0
+
+
+# -- the three-state gray-failure machine ----------------------------------
+#
+# The heartbeat detector above answers one binary question: has the rank
+# stamped its liveness word recently?  A GRAY failure — throttled,
+# SIGSTOP'd-and-resumed, swapping — keeps stamping (the heartbeat thread
+# is cheap) while its win ops crawl, so it convoys its neighbors without
+# ever tripping the timeout.  EdgeHealth tracks the per-peer *edge*
+# signal instead (deadline misses observed on the win-op path) through
+# three states:
+#
+#     ALIVE --(>= suspect_misses consecutive misses)--> SUSPECT
+#     SUSPECT --(>= promote_clean consecutive cleans)--> ALIVE
+#     any --(death declaration)--> DEAD (absorbing)
+#
+# with one hysteresis rule: transitions for a peer are at least
+# ``floor_s`` apart (DEAD excepted — death is never delayed), so the
+# demote/promote cycle a flapping rank can induce is bounded below by
+# the floor.  The clock is injectable for deterministic simulation (the
+# analysis ``adaptive.hysteresis`` rule drives adversarial schedules
+# through a fake clock).
+
+EDGE_ALIVE = "alive"
+EDGE_SUSPECT = "suspect"
+EDGE_DEAD = "dead"
+
+_EDGE_STATE_CODE = {EDGE_ALIVE: 0, EDGE_SUSPECT: 1, EDGE_DEAD: 2}
+
+
+class EdgeHealth:
+    """Per-peer three-state gray-failure machine (see module comment).
+
+    Peers are identified by whatever ids the caller feeds (the island
+    runtime uses GLOBAL ranks so the machine survives membership-epoch
+    switches).  Thread-safe; all mutation happens under one lock.
+    """
+
+    def __init__(self, misses: Optional[int] = None,
+                 clean: Optional[int] = None,
+                 floor_s: Optional[float] = None,
+                 clock=time.monotonic):
+        self.misses = suspect_misses() if misses is None else int(misses)
+        self.clean = promote_clean() if clean is None else int(clean)
+        self.floor_s = demote_floor_s() if floor_s is None else float(floor_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state: dict = {}        # peer -> state string
+        self._miss_streak: dict = {}  # peer -> consecutive misses
+        self._clean_streak: dict = {} # peer -> consecutive cleans
+        self._since: dict = {}        # peer -> last transition time
+        self._log: list = []          # [{t, peer, frm, to}]
+
+    def state(self, peer: int) -> str:
+        with self._lock:
+            return self._state.get(int(peer), EDGE_ALIVE)
+
+    def suspects(self):
+        with self._lock:
+            return {p for p, s in self._state.items() if s == EDGE_SUSPECT}
+
+    def time_in_state(self, peer: int) -> float:
+        with self._lock:
+            since = self._since.get(int(peer))
+        return float("inf") if since is None else self._clock() - since
+
+    def transitions(self):
+        """The transition log ``[{t, peer, frm, to}, ...]`` (copies) —
+        the artifact the hysteresis verifier rule audits."""
+        with self._lock:
+            return [dict(e) for e in self._log]
+
+    def _floor_open(self, peer: int, now: float) -> bool:
+        since = self._since.get(peer)
+        return since is None or now - since >= self.floor_s
+
+    def _transition(self, peer: int, to: str, now: float,
+                    adopted: bool = False) -> None:
+        frm = self._state.get(peer, EDGE_ALIVE)
+        self._state[peer] = to
+        self._since[peer] = now
+        self._miss_streak[peer] = 0
+        self._clean_streak[peer] = 0
+        ev = {"t": now, "peer": peer, "frm": frm, "to": to}
+        if adopted:
+            ev["adopted"] = True
+        self._log.append(ev)
+        reg = _telemetry.get_registry()
+        if reg.enabled:
+            reg.gauge("adaptive.edge_state", peer=peer).set(
+                _EDGE_STATE_CODE[to])
+            reg.journal("edge_state", peer=peer, frm=frm, to=to,
+                        adopted=adopted)
+
+    def note_miss(self, peer: int) -> str:
+        """One edge-deadline miss observed on ``peer``.  Returns the
+        (possibly new) state."""
+        peer = int(peer)
+        now = self._clock()
+        with self._lock:
+            st = self._state.get(peer, EDGE_ALIVE)
+            if st == EDGE_DEAD:
+                return st
+            self._clean_streak[peer] = 0
+            self._miss_streak[peer] = self._miss_streak.get(peer, 0) + 1
+            if (st == EDGE_ALIVE
+                    and self._miss_streak[peer] >= self.misses
+                    and self._floor_open(peer, now)):
+                self._transition(peer, EDGE_SUSPECT, now)
+            return self._state.get(peer, EDGE_ALIVE)
+
+    def note_clean(self, peer: int) -> str:
+        """One on-deadline observation of ``peer`` (a fresh deposit, a
+        fast acquire).  Returns the (possibly new) state."""
+        peer = int(peer)
+        now = self._clock()
+        with self._lock:
+            st = self._state.get(peer, EDGE_ALIVE)
+            if st == EDGE_DEAD:
+                return st
+            self._miss_streak[peer] = 0
+            self._clean_streak[peer] = self._clean_streak.get(peer, 0) + 1
+            if (st == EDGE_SUSPECT
+                    and self._clean_streak[peer] >= self.clean
+                    and self._floor_open(peer, now)):
+                self._transition(peer, EDGE_ALIVE, now)
+            return self._state.get(peer, EDGE_ALIVE)
+
+    def absolve(self, peer: int) -> str:
+        """Adopt a fleet-level PROMOTE verdict for ``peer``.
+
+        After a demotion only the anchor keeps an edge to the straggler,
+        so every other member's machine is starved of observations and
+        holds the peer SUSPECT forever; when the anchor's (floored,
+        evidence-based) promote commits, those stale verdicts would
+        instantly re-demote — an epoch thrash no local floor can stop,
+        because no local state ever transitions.  Absolving mirrors the
+        anchor's verdict: the peer resets to ALIVE with fresh streaks
+        and a fresh floor clock (so a relapse is again floored locally).
+        Logged with ``adopted=True`` — the hysteresis audit exempts
+        mirrored verdicts, whose floor was paid at the anchor.  DEAD
+        stays absorbing."""
+        peer = int(peer)
+        now = self._clock()
+        with self._lock:
+            st = self._state.get(peer, EDGE_ALIVE)
+            if st in (EDGE_DEAD, EDGE_ALIVE):
+                return st
+            self._transition(peer, EDGE_ALIVE, now, adopted=True)
+            return EDGE_ALIVE
+
+    def note_dead(self, peer: int) -> str:
+        """Absorbing death (the heartbeat detector's verdict outranks
+        the gray-failure machine; never floor-delayed)."""
+        peer = int(peer)
+        now = self._clock()
+        with self._lock:
+            if self._state.get(peer) != EDGE_DEAD:
+                self._transition(peer, EDGE_DEAD, now)
+            return EDGE_DEAD
+
+
 class FailureDetector:
     """Background heartbeater + liveness judge over a job transport."""
 
@@ -96,6 +293,12 @@ class FailureDetector:
         self._thread: Optional[threading.Thread] = None
         self._declared: Set[int] = set()
         self._lock = threading.Lock()
+        # optional gray-failure machine (resilience/adaptive.py attaches
+        # one keyed by GLOBAL rank): death declarations flow into it so
+        # DEAD outranks SUSPECT; ``to_peer`` maps this detector's local
+        # ranks to the machine's peer ids (identity when unset)
+        self.edge_health: Optional[EdgeHealth] = None
+        self.to_peer = None
         self.beat()
 
     @property
@@ -183,6 +386,9 @@ class FailureDetector:
             self._note_declared(int(rank), how="external")
 
     def _note_declared(self, rank: int, how: str) -> None:
+        if self.edge_health is not None:
+            peer = rank if self.to_peer is None else self.to_peer(rank)
+            self.edge_health.note_dead(peer)
         reg = _telemetry.get_registry()
         if reg.enabled:
             reg.counter("resilience.death_declarations").inc()

@@ -50,6 +50,7 @@ __all__ = [
     "HealedTopology",
     "heal_topology",
     "grow_topology",
+    "demote_topology",
     "healed_weight_matrix",
 ]
 
@@ -71,6 +72,7 @@ class HealedTopology:
     to_global: Tuple[int, ...]   # local node id -> global rank
     reconnected: bool            # ring edges were added for connectivity
     joined: Tuple[int, ...] = () # sorted global ranks spliced in (grow)
+    demoted: Tuple[int, ...] = () # sorted global ranks degree-capped
 
     @property
     def size(self) -> int:
@@ -219,6 +221,93 @@ def grow_topology(topo: nx.DiGraph,
         to_global=to_global,
         reconnected=reconnected,
         joined=tuple(sorted(join_set)),
+    )
+
+
+def demote_topology(topo: nx.DiGraph,
+                    stragglers: Iterable[int]) -> HealedTopology:
+    """Cap each straggler's gossip degree to ONE edge without excising
+    it — the gray-failure middle ground between full membership and
+    death.  Every member (stragglers included) stays in the view; a
+    straggler keeps exactly one bidirectional **anchor** edge to its
+    lowest-id healthy neighbor (or, if every neighbor is itself a
+    straggler, to the lowest healthy member), so it still receives and
+    contributes mass — just without sitting on anyone else's critical
+    path.  The healthy core is re-symmetrized, ring-repaired if the
+    straggler was a cut vertex, Metropolis–Hastings re-weighted, and
+    recompiled — the exact pipeline heal/grow run, so the demoted W is
+    doubly stochastic with a positive spectral gap by the same
+    construction.
+
+    Deterministic from (topo, stragglers): every member computes the
+    same demoted graph from the same inputs, so the epoch record any
+    observer commits is the one every other observer would have
+    committed.
+
+    Raises ValueError for an empty straggler set, stragglers outside
+    the topology, or fewer than one healthy member.
+    """
+    nodes = set(int(n) for n in topo.nodes)
+    strag = set(int(r) for r in stragglers)
+    if not strag:
+        raise ValueError("no stragglers: demote_topology needs >= 1 rank")
+    if not strag <= nodes:
+        raise ValueError(
+            f"straggler(s) {sorted(strag - nodes)} not in topology")
+    healthy = sorted(nodes - strag)
+    if not healthy:
+        raise ValueError("every member is a straggler: nothing to "
+                         "anchor to (heal or wait instead)")
+    members = tuple(sorted(nodes))
+
+    G = _symmetrized_induced(topo, members)
+    G.add_nodes_from(members)  # isolated members survive symmetrization
+    for s in sorted(strag):
+        nbrs = sorted(set(G.successors(s)))
+        anchors = [u for u in nbrs if u not in strag]
+        anchor = anchors[0] if anchors else healthy[0]
+        for u in nbrs:
+            if u != anchor:
+                G.remove_edge(s, u)
+                G.remove_edge(u, s)
+        if anchor != s and not G.has_edge(s, anchor):
+            G.add_edge(s, anchor)
+            G.add_edge(anchor, s)
+
+    # the straggler may have been a cut vertex of the healthy core:
+    # ring-repair over the HEALTHY members only (a ring through a
+    # straggler would re-raise its degree past the cap)
+    reconnected = False
+    m = len(healthy)
+    if m > 1 and not nx.is_strongly_connected(G.subgraph(healthy)):
+        reconnected = True
+        for i in range(m):
+            u, v = healthy[i], healthy[(i + 1) % m]
+            if u != v:
+                G.add_edge(u, v)
+                G.add_edge(v, u)
+
+    to_global = members
+    to_local = {g: i for i, g in enumerate(members)}
+    H = nx.relabel_nodes(G, to_local, copy=True)
+    topology_util.MetropolisHastingsWeights(H)
+    H.graph["demoted_from"] = tuple(sorted(strag))
+
+    plan = compile_plan(H)
+    row_err, col_err = plan.stochasticity_error()
+    if max(row_err, col_err) > _STOCHASTICITY_TOL:
+        raise RuntimeError(
+            f"demoted plan not doubly stochastic: row={row_err:.3e} "
+            f"col={col_err:.3e} (tol {_STOCHASTICITY_TOL:.0e})")
+    return HealedTopology(
+        survivors=members,
+        dead=(),
+        topology=H,
+        plan=plan,
+        to_local=to_local,
+        to_global=to_global,
+        reconnected=reconnected,
+        demoted=tuple(sorted(strag)),
     )
 
 

@@ -129,6 +129,11 @@ def record_graph(record: dict) -> nx.DiGraph:
     G.add_edges_from((int(u), int(v)) for u, v in record["edges"])
     topology_util.MetropolisHastingsWeights(G)
     G.graph["grown_from"] = tuple(int(j) for j in record.get("joined", ()))
+    if record.get("reweight"):
+        # adaptive reweight records tag the graph like demote_topology
+        # does, so the analysis rules see the same artifact either way
+        G.graph["demoted_from"] = tuple(
+            int(g) for g in record.get("demoted", ()))
     return G
 
 
@@ -311,5 +316,56 @@ class MembershipBoard:
             doc["requests"] = []
             self._publish(doc)
         # the cheap probe members poll at round barriers
+        shm_native.publish_membership_epoch(self.job, new_epoch)
+        return rec
+
+    # -- adaptive-topology side (resilience/adaptive.py) ------------------
+
+    def commit_reweight(self, committer: int, prev_epoch: int,
+                        members: Sequence[int], edges: Sequence,
+                        windows: List[dict], associated_p: bool,
+                        demoted: Sequence[int], promoted: Sequence[int],
+                        base_edges: Sequence) -> Optional[dict]:
+        """Commit a **reweight** epoch record: same member set, new
+        topology — the adaptive demote/promote switch (straggler degree
+        capped, or restored).  The record carries ``reweight: True`` so
+        the switch points can tell it from a join grant, plus the
+        demoted set and the base (pre-demotion) edge list any member
+        needs to compute the NEXT demote or the promote restore.
+
+        First-wins and idempotent like :meth:`grant`: raced observers
+        of the same straggler find epoch ``prev_epoch + 1`` already
+        committed and get that record back (the caller checks its
+        ``reweight`` flag — a raced JOIN grant wins the epoch and the
+        demote retries next tick).  Returns the committed-or-existing
+        record.
+        """
+        with self._locked():
+            doc = self.read()
+            if doc is None:
+                raise RuntimeError(f"membership board vanished for "
+                                   f"{self.job!r}")
+            new_epoch = int(prev_epoch) + 1
+            for rec in doc["epochs"]:
+                if int(rec["epoch"]) == new_epoch:
+                    return rec  # first observer (or a join) won the epoch
+            rec = {
+                "epoch": new_epoch,
+                "members": [int(m) for m in members],
+                "joined": [],
+                "removed": [],
+                "granted": {},
+                "sponsor": int(committer),
+                "edges": [[int(u), int(v)] for u, v in edges],
+                "windows": windows,
+                "associated_p": bool(associated_p),
+                "reweight": True,
+                "demoted": [int(g) for g in demoted],
+                "promoted": [int(g) for g in promoted],
+                "base_edges": [[int(u), int(v)] for u, v in base_edges],
+            }
+            doc["epochs"].append(rec)
+            doc["epoch"] = new_epoch
+            self._publish(doc)
         shm_native.publish_membership_epoch(self.job, new_epoch)
         return rec

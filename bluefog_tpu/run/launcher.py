@@ -66,6 +66,10 @@ def build_env(args, base_env=None) -> dict:
         env["BLUEFOG_LOG_LEVEL"] = "debug"
     if args.timeline:
         env["BLUEFOG_TIMELINE"] = args.timeline
+    if getattr(args, "adaptive", False):
+        # islands mode: straggler-aware gossip (resilience/adaptive.py);
+        # plain env spelling BFTPU_ADAPTIVE=1 is forwarded anyway
+        env["BFTPU_ADAPTIVE"] = "1"
     # Multi-host bootstrap: forwarded to jax.distributed.initialize via env
     # (JAX reads these standard variables).
     if args.coordinator:
@@ -582,6 +586,15 @@ def main(argv=None) -> int:
         "joiner process (up to BFTPU_MAX_RESPAWNS) instead of failing "
         "the run — the survivors heal, the replacement rejoins under a "
         "new global rank",
+    )
+    parser.add_argument(
+        "--adaptive",
+        action="store_true",
+        help="islands mode: enable the adaptive edge-health control loop "
+        "(BFTPU_ADAPTIVE=1) — deadline-missed edges are absorbed per "
+        "round and a persistently slow rank is demoted to one anchor "
+        "edge instead of convoying the fleet (docs/RESILIENCE.md, "
+        "'Adaptive topology')",
     )
     parser.add_argument(
         "--attach",

@@ -25,6 +25,7 @@ from bluefog_tpu.telemetry.registry import (
     LEDGER_PENDING,
     SNAPSHOT_SCHEMA,
     _safe_name,
+    quantile_from_buckets,
 )
 
 __all__ = [
@@ -117,6 +118,12 @@ def merge_snapshots(snaps: List[dict]) -> dict:
                                  zip(cur["counts"], h["counts"])]
                 cur["sum"] += float(h["sum"])
             # mismatched bucket layouts are skipped (schema rule flags them)
+    for h in hists.values():
+        # cross-rank latency quantiles ride the merged buckets — the
+        # same estimator the adaptive edge-health policy runs per rank
+        for q, key in ((0.5, "p50"), (0.99, "p99")):
+            v = quantile_from_buckets(h["buckets"], h["counts"], q)
+            h[key] = None if v != v else v  # NaN -> null for JSON
     merged = {
         "schema": MERGED_SCHEMA,
         "ranks": sorted(ranks),

@@ -47,6 +47,7 @@ __all__ = [
     "LEDGER_PENDING",
     "MASS_JOIN_ADMITTED",
     "DEFAULT_LATENCY_BUCKETS_S",
+    "quantile_from_buckets",
     "telemetry_dir",
     "Counter",
     "Gauge",
@@ -147,6 +148,9 @@ class _NullMetric:
     def observe(self, v):
         pass
 
+    def quantile(self, q):
+        return float("nan")
+
 
 _NULL = _NullMetric()
 
@@ -203,6 +207,33 @@ class Gauge:
                 "value": self.value, "max": self.max}
 
 
+def quantile_from_buckets(buckets, counts, q: float) -> float:
+    """Prometheus-style interpolated quantile from fixed buckets.
+
+    ``buckets`` are the finite upper edges, ``counts`` the per-bucket
+    tallies (len(buckets)+1, with the implicit +Inf bucket last).  The
+    q-th observation is located by cumulative count and linearly
+    interpolated within its bucket (lower edge 0 for the first bucket);
+    observations in the +Inf bucket clamp to the last finite edge — the
+    estimate is conservative there, never invented.  NaN on an empty
+    histogram."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile {q} outside [0, 1]")
+    total = sum(counts)
+    if total == 0:
+        return float("nan")
+    target = q * total
+    cum = 0.0
+    for i, c in enumerate(counts[:-1]):
+        if cum + c >= target and c > 0:
+            lo = 0.0 if i == 0 else float(buckets[i - 1])
+            hi = float(buckets[i])
+            frac = (target - cum) / c
+            return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        cum += c
+    return float(buckets[-1])
+
+
 class Histogram:
     """Fixed-bucket histogram with prometheus ``le`` semantics: a value
     lands in the FIRST bucket whose upper bound is >= the value (exact
@@ -234,6 +265,15 @@ class Histogram:
     @property
     def count(self) -> int:
         return sum(self.counts)
+
+    def quantile(self, q: float) -> float:
+        """Interpolated q-quantile (0 <= q <= 1) of the observations —
+        p50/p99 for the adaptive edge-health policy and the merge CLI.
+        NaN while empty; +Inf-bucket hits clamp to the last finite
+        edge (see :func:`quantile_from_buckets`)."""
+        with self._lock:
+            counts = list(self.counts)
+        return quantile_from_buckets(self.buckets, counts, q)
 
     def to_dict(self) -> dict:
         return {"name": self.name, "labels": self.labels,
