@@ -473,6 +473,17 @@ def main():
             print(json.dumps(trc), file=sys.stderr)
         except Exception as e:  # noqa: BLE001
             print(f"tracing overhead phase failed: {e!r}", file=sys.stderr)
+    spg = None
+    if time.perf_counter() - t_start < budget_s:
+        try:
+            # status-page overhead gate (docs/OBSERVABILITY.md "Live
+            # introspection"): the always-on per-op page republish +
+            # holder-word stores must stay < 2%
+            from gossip_bandwidth import measure_statuspage_overhead
+            spg = measure_statuspage_overhead(nprocs=2)
+            print(json.dumps(spg), file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"statuspage overhead phase failed: {e!r}", file=sys.stderr)
     rec = None
     if time.perf_counter() - t_start < budget_s:
         try:
@@ -568,6 +579,9 @@ def main():
     if trc is not None:
         headline["tracing_overhead_pct"] = trc["value"]
         headline["tracing_overhead_metric"] = trc["metric"]
+    if spg is not None:
+        headline["statuspage_overhead_pct"] = spg["value"]
+        headline["statuspage_overhead_metric"] = spg["metric"]
     if rec is not None:
         headline["recovery_ms"] = rec["value"]
         headline["recovery_metric"] = rec["metric"]

@@ -254,6 +254,58 @@ def measure_tracing_overhead(nprocs: int = 2, mb: float = 4.0,
     }
 
 
+def measure_statuspage_overhead(nprocs: int = 2, mb: float = 4.0,
+                                iters: int = 120, warmup: int = 10,
+                                repeats: int = 5) -> dict:
+    """Status-page-on vs -off cost of the island gossip loop.
+
+    Same interleaved best-of-``repeats`` protocol as
+    :func:`measure_tracing_overhead`, toggling ``BFTPU_STATUSPAGE``.
+    "On" (the default in production) pays one seqlocked whole-page
+    ``pack_into`` republish plus a trace-control poll per win_update and
+    the holder-word store per mutex acquire/release; the live
+    introspection plane's contract (docs/OBSERVABILITY.md "Live
+    introspection") is < 2% — it must stay cheap enough to never be
+    worth turning off.
+    """
+    import functools
+
+    from bluefog_tpu import islands
+
+    def one_dt() -> float:
+        res = islands.spawn(
+            functools.partial(_island_worker, mb=mb, iters=iters,
+                              warmup=warmup, topo_name="ring"),
+            nprocs, timeout=600.0,
+        )
+        return max(d for _, d in res)
+
+    prev = os.environ.pop("BFTPU_STATUSPAGE", None)
+    t_off = t_on = None
+    try:
+        for _ in range(repeats):
+            os.environ["BFTPU_STATUSPAGE"] = "0"
+            dt = one_dt()
+            t_off = dt if t_off is None else min(t_off, dt)
+            os.environ["BFTPU_STATUSPAGE"] = "1"
+            dt = one_dt()
+            t_on = dt if t_on is None else min(t_on, dt)
+    finally:
+        os.environ.pop("BFTPU_STATUSPAGE", None)
+        if prev is not None:
+            os.environ["BFTPU_STATUSPAGE"] = prev
+    pct = (t_on - t_off) / t_off * 100.0 if t_off else 0.0
+    return {
+        "metric": f"island gossip status-page overhead ({nprocs} processes, "
+                  f"{mb:g} MB payload, best of {repeats})",
+        "value": round(pct, 2),
+        "unit": "%",
+        "t_off_s": round(t_off, 4),
+        "t_on_s": round(t_on, 4),
+        "contract_pct": 2.0,
+    }
+
+
 def _probe_gbs(mb: float, iters: int, chunk: int = None,
                depth: int = None) -> float:
     """One pipelined self-edge configuration: write leg and drain leg of

@@ -30,6 +30,11 @@ consumers (CLI, pytest, CI):
   retained at degree one, restores round-trip to the pre-demotion W,
   and the driven EdgeHealth machine admits no demote/promote cycle
   shorter than the hysteresis floor;
+- **introspect** (:mod:`.introspect_rules`) — the live introspection
+  plane: status pages read back schema-exact, settled, and
+  ledger-consistent; mutex holder words always name a live member and
+  clear on release/heal; the critical-path blame feed gating adaptive
+  demotion stays monotone;
 - the **fixture corpus** (:mod:`.fixtures`) — seeded bugs proving every
   rule fires.
 
@@ -55,6 +60,7 @@ from bluefog_tpu.analysis import (  # noqa: F401
     fixtures,
     hlo_corpus,
     hlo_rules,
+    introspect_rules,
     plan_rules,
     resilience_rules,
     seqlock_model,

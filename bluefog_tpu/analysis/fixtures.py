@@ -29,6 +29,7 @@ from bluefog_tpu.analysis import (
     adaptive_rules,
     epoch_rules,
     hlo_rules,
+    introspect_rules,
     plan_rules,
     resilience_rules,
     seqlock_model,
@@ -401,6 +402,85 @@ def _trace_clock_skew() -> List[Finding]:
         corpus, label="fixture[clock-skew]")
 
 
+# ---------------------------------------------------------------------------
+# introspect fixtures: a real status page / holder board / blame feed,
+# each broken the way its failure mode would break it
+# ---------------------------------------------------------------------------
+
+
+def _introspect_torn_page() -> List[Finding]:
+    """A REAL published status page decoded, then presented the way a
+    reader racing a stuck writer would see it: odd seq, clobbered
+    version, and a balance that stopped matching its own totals."""
+    import tempfile
+
+    from bluefog_tpu.introspect import statuspage as sp
+    from bluefog_tpu.native import shm_native
+
+    with tempfile.TemporaryDirectory(prefix="bftpu_fixture_") as td:
+        saved = shm_native._FALLBACK_DIR
+        shm_native._FALLBACK_DIR = td
+        try:
+            page = sp.StatusPage("fixture", 0)
+            try:
+                page.publish(nranks=2, step=7, epoch=0, op_id=7,
+                             last_op="win_update:g",
+                             ledger={"deposits": 4.0, "collected": 3.0,
+                                     "drained": 1.0, "pending": 0.0},
+                             edges=[(1, 0, 0.2)])
+                decoded = sp.read_status_page(sp.status_page_path(
+                    "fixture", 0))
+            finally:
+                page.close(unlink=True)
+        finally:
+            shm_native._FALLBACK_DIR = saved
+    decoded["seq"] = 7                  # accepted mid-write
+    decoded["version"] = 99             # foreign layout
+    decoded["ledger"]["balance"] = 3.5  # 4 - 3 - 1 == 0, not 3.5
+    return introspect_rules.check_status_page(decoded, "fixture[torn-page]")
+
+
+def _introspect_ghost_holder() -> List[Finding]:
+    """A real holder board where the holding rank died and the heal path
+    never ran mutex_break: the word keeps blaming a ghost."""
+    import tempfile
+
+    from bluefog_tpu.native import shm_native
+    from bluefog_tpu.native.shm_native import HolderBoard
+
+    with tempfile.TemporaryDirectory(prefix="bftpu_fixture_") as td:
+        saved = shm_native._FALLBACK_DIR
+        shm_native._FALLBACK_DIR = td
+        try:
+            board = HolderBoard("fixture-hb", 4)
+            try:
+                board.set_holder(1, 3)  # rank 3 acquires, then dies
+                snap = board.snapshot()
+            finally:
+                board.close(unlink=True)
+        finally:
+            shm_native._FALLBACK_DIR = saved
+    return introspect_rules.check_holder_words(
+        snap, members={0, 1, 2}, dead={3}, label="fixture[ghost-holder]")
+
+
+def _introspect_blame_regression() -> List[Finding]:
+    """A real AdaptivePolicy blame feed reset mid-run (the bug a raced
+    re-init or an epoch switch dropping the dict would produce): the
+    snapshot sequence goes backward."""
+    from bluefog_tpu.resilience.adaptive import AdaptivePolicy
+
+    pol = AdaptivePolicy()
+    pol.note_round_blame(3)
+    pol.note_round_blame(3)
+    first = dict(pol._cp_blame)
+    pol._cp_blame.clear()  # seeded bug: feed reset mid-run
+    pol.note_round_blame(3)
+    second = dict(pol._cp_blame)
+    return introspect_rules.check_blame_monotone(
+        [first, second], "fixture[blame-regression]")
+
+
 FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
     # plan family
     "plan-duplicate-destination": _plan_duplicate_destination,
@@ -454,6 +534,10 @@ FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
     "telemetry-snapshot-bad-schema": _telemetry_snapshot_bad_schema,
     "telemetry-conservation-broken": _telemetry_conservation_broken,
     "envlint-undocumented-var": _envlint_undocumented_var,
+    # introspect family: torn/foreign page, ghost holder, reset feed
+    "introspect-torn-page": _introspect_torn_page,
+    "introspect-ghost-holder": _introspect_ghost_holder,
+    "introspect-blame-regression": _introspect_blame_regression,
     # trace family: crossed spans, corrupted flow identity, clock skew
     "trace-unbalanced-nesting": _trace_unbalanced_nesting,
     "trace-dangling-flow": _trace_dangling_flow,

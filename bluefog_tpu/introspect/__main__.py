@@ -1,0 +1,182 @@
+"""``bftpu-top``: live fleet view over the shm status pages.
+
+    python -m bluefog_tpu.introspect --job JOB            # refreshing view
+    python -m bluefog_tpu.introspect --job JOB --once --json
+    python -m bluefog_tpu.introspect --job JOB --trace on
+    bftpu-run --attach JOB top [--once --json]            # same thing
+
+Reads are passive (seqlock readers over the per-rank pages + the holder
+board): attaching never blocks or perturbs the run.  The launcher
+control socket, when present, contributes supervisor state (live pids,
+pending scale); the pages alone are enough for jobs spawned in-process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import time
+from typing import Dict, Optional
+
+from bluefog_tpu.introspect import statuspage as sp
+
+_EDGE_CHAR = {"alive": ".", "suspect": "S", "dead": "D", "demoted": "d"}
+
+
+def _launcher_state(job: str) -> Optional[dict]:
+    """Best-effort ``top`` query against the launcher control socket."""
+    from bluefog_tpu.run.launcher import control_sock_path
+
+    path = control_sock_path(job)
+    if not os.path.exists(path):
+        return None
+    try:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(1.0)
+        s.connect(path)
+        s.sendall((json.dumps({"cmd": "top"}) + "\n").encode())
+        line = s.makefile("r").readline()
+        s.close()
+        rep = json.loads(line)
+        return rep if rep.get("ok") else None
+    except (OSError, ValueError):
+        return None
+
+
+def snapshot(job: str) -> dict:
+    """One merged fleet snapshot: status pages + holders + launcher."""
+    snap = sp.collect(job)
+    launcher = _launcher_state(job)
+    if launcher is not None:
+        snap["launcher"] = {k: launcher[k] for k in
+                            ("live", "joiners", "pending_scale")
+                            if k in launcher}
+    return snap
+
+
+def _rates(prev: Dict[int, tuple], snap: dict) -> Dict[int, float]:
+    """Per-rank step/s between two snapshots (NaN-free: absent = 0)."""
+    rates: Dict[int, float] = {}
+    for rs, page in snap["ranks"].items():
+        if "error" in page:
+            continue
+        r = int(rs)
+        cur = (page["step"], page["mono_ts"])
+        if r in prev:
+            dstep = cur[0] - prev[r][0]
+            dt = cur[1] - prev[r][1]
+            if dt > 0:
+                rates[r] = dstep / dt
+        prev[r] = cur
+    return rates
+
+
+def render(snap: dict, rates: Dict[int, float]) -> str:
+    """The fleet view as plain text (one frame of the live display)."""
+    lines = []
+    ranks = sorted(int(r) for r in snap["ranks"])
+    holders = {int(m): h for m, h in snap.get("holders", {}).items()}
+    held_by = {}  # holder rank -> [mutexes]
+    for m, h in holders.items():
+        held_by.setdefault(h, []).append(m)
+    lines.append(f"bftpu-top — job {snap['job']}  epoch {snap['epoch']}  "
+                 f"{time.strftime('%H:%M:%S', time.localtime(snap['wall_ts']))}")
+    la = snap.get("launcher")
+    if la:
+        lines.append(f"launcher: live={len(la.get('live', []))} "
+                     f"joiners={la.get('joiners', 0)} "
+                     f"pending_scale={la.get('pending_scale', 0)}")
+    lines.append("")
+    lines.append(f"{'RANK':>4} {'STEP':>8} {'STEP/S':>7} {'EPOCH':>5} "
+                 f"{'LAST OP':<12} {'BALANCE':>10} {'HOLDS':<8} EDGES")
+    for r in ranks:
+        page = snap["ranks"][str(r)]
+        if "error" in page:
+            lines.append(f"{r:>4} {'—':>8} {page['error']}")
+            continue
+        rate = rates.get(r)
+        edges = " ".join(
+            f"{e['peer']}:{_EDGE_CHAR.get(e['state'], '?')}"
+            for e in page["edges"])
+        holds = ",".join(f"m{m}" for m in sorted(held_by.get(r, []))) or "-"
+        lines.append(
+            f"{r:>4} {page['step']:>8} "
+            f"{('%.1f' % rate) if rate is not None else '—':>7} "
+            f"{page['epoch']:>5} {page['last_op']:<12} "
+            f"{page['ledger']['balance']:>10.3g} {holds:<8} {edges}")
+    if snap.get("suspects"):
+        lines.append("")
+        lines.append(f"straggler suspects: "
+                     f"{', '.join(str(s) for s in snap['suspects'])}")
+    if holders:
+        lines.append(f"lock holders: " + ", ".join(
+            f"mutex {m} held by rank {h}" for m, h in sorted(holders.items())))
+    lines.append("")
+    lines.append("edges: .=alive S=suspect D=dead d=demoted "
+                 "(as seen by the row's rank)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="bftpu-top",
+        description="Live fleet view of a running islands job "
+        "(status pages + lock holders + straggler suspects).")
+    parser.add_argument("--job", required=True,
+                        help="island job name (BLUEFOG_ISLAND_JOB)")
+    parser.add_argument("--once", action="store_true",
+                        help="print one snapshot and exit")
+    parser.add_argument("--json", action="store_true",
+                        help="emit machine-readable JSON instead of the "
+                        "table (schema bftpu-top/1)")
+    parser.add_argument("--interval", type=float, default=1.0,
+                        help="refresh interval in seconds (live mode)")
+    parser.add_argument("--trace", choices=("on", "off", "default"),
+                        default=None,
+                        help="publish the runtime trace-control word "
+                        "(flips BFTPU_TRACING in running ranks) and exit")
+    args = parser.parse_args(argv)
+
+    if args.trace is not None:
+        mode = {"on": sp.TRACE_ON, "off": sp.TRACE_OFF,
+                "default": sp.TRACE_DEFAULT}[args.trace]
+        gen = sp.publish_trace_control(args.job, mode)
+        print(json.dumps({"ok": True, "job": args.job, "mode": args.trace,
+                          "generation": gen}))
+        return 0
+
+    snap = snapshot(args.job)
+    if not snap["ranks"]:
+        print(f"bftpu-top: no status pages for job {args.job!r} — is the "
+              f"run up (and BFTPU_STATUSPAGE not 0)?", file=sys.stderr)
+        if args.once and args.json:
+            print(json.dumps(snap, indent=2))
+        return 1
+
+    if args.once:
+        print(json.dumps(snap, indent=2) if args.json
+              else render(snap, {}))
+        return 0
+
+    prev: Dict[int, tuple] = {}
+    _rates(prev, snap)  # seed the rate baseline
+    try:
+        while True:
+            time.sleep(max(0.1, args.interval))
+            snap = snapshot(args.job)
+            rates = _rates(prev, snap)
+            if args.json:
+                print(json.dumps(snap))
+            else:
+                # clear + home, then one frame — plain ANSI, no curses dep
+                sys.stdout.write("\x1b[2J\x1b[H" + render(snap, rates) + "\n")
+                sys.stdout.flush()
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,336 @@
+"""Per-rank live status pages + the job trace-control word.
+
+The status page is the read side of the live introspection plane
+(docs/OBSERVABILITY.md "Live introspection"): every island rank keeps one
+small versioned mmap struct next to its shm segments
+(``bf_<job>_status_r<rank>``) and republishes it once per window op —
+current step/round, membership epoch, last op + op_id, per-edge
+:mod:`EdgeHealth <bluefog_tpu.resilience.detector>` state and deadline,
+and the mass-ledger totals.  The page is seqlock'd exactly like the
+mailbox slots (seq → odd, payload, seq → even), so an external reader
+(``bftpu-top``) NEVER blocks or perturbs the writer: it just retries a
+torn bracket.  Pages ride the ``seg_name`` prefix, so
+:func:`bluefog_tpu.native.shm_native.unlink_all` reclaims them.
+
+The trace-control word (``bf_<job>_tracectl``) is the write side: a
+(generation, mode) pair published by atomic rename — the same idiom as
+the membership-epoch word — that lets ``bftpu-top trace on|off`` flip
+``BFTPU_TRACING`` inside running ranks without a restart.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import struct
+import time
+from typing import Dict, List, Optional, Tuple
+
+from bluefog_tpu.native import shm_native
+
+STATUS_SCHEMA = "bftpu-statuspage/1"
+STATUS_MAGIC = 0x42465350  # "BFSP"
+STATUS_VERSION = 1
+
+#: Page layout: header (magic u32, version u32, seq u64), fixed block,
+#: then up to MAX_EDGES edge records; the whole page is padded to
+#: PAGE_BYTES so the file size is stable across republishes.
+_HEAD = struct.Struct("<IIQ")                 # magic, version, seq
+_FIXED = struct.Struct("<iiiiQQQdd16sdddd")   # rank, nranks, pid, n_edges,
+#                                               step, epoch, op_id,
+#                                               wall_ts, mono_ts, last_op,
+#                                               ledger dep/col/drn/pend
+_EDGE = struct.Struct("<iid")                 # peer_global, state, deadline_s
+MAX_EDGES = 32
+PAGE_BYTES = 1024
+assert _HEAD.size + _FIXED.size + MAX_EDGES * _EDGE.size <= PAGE_BYTES
+
+#: EdgeHealth state codes as written into edge records (3 = demoted is
+#: an islands-level overlay the detector itself does not track).
+EDGE_STATE_NAMES = {0: "alive", 1: "suspect", 2: "dead", 3: "demoted"}
+
+_LEDGER_KEYS = ("deposits", "collected", "drained", "pending")
+
+
+class TornPageError(RuntimeError):
+    """A status page stayed torn (odd/moving seq) across every retry."""
+
+
+def status_page_path(job: str, rank: int) -> str:
+    return os.path.join(
+        shm_native._FALLBACK_DIR,
+        shm_native.seg_name(job, f"status_r{int(rank)}")[1:])
+
+
+class StatusPage:
+    """The writer: owned by one rank, republished once per window op.
+
+    ``publish`` is a few ``pack_into`` calls on an mmap — no locks, no
+    syscalls — which is what keeps the always-on plane under the < 2%
+    ``statuspage_overhead_pct`` bench gate."""
+
+    def __init__(self, job: str, rank: int):
+        self.job = str(job)
+        self.rank = int(rank)
+        self._seg = shm_native._FallbackSegment(
+            status_page_path(job, rank), PAGE_BYTES)
+        self._seq = 0
+        _HEAD.pack_into(self._seg._mm, 0, STATUS_MAGIC, STATUS_VERSION, 0)
+
+    def publish(self, *, nranks: int, step: int, epoch: int, op_id: int,
+                last_op: str = "", ledger: Optional[Dict[str, float]] = None,
+                edges=()) -> None:
+        """Seqlocked single-writer update of the whole page.
+
+        ``edges`` is an iterable of ``(peer_global, state_code,
+        deadline_s)`` tuples (truncated at MAX_EDGES); ``ledger`` maps
+        the ``_LEDGER_KEYS`` to mass totals (missing keys read 0.0)."""
+        mm = self._seg._mm
+        led = ledger or {}
+        ed = list(edges)[:MAX_EDGES]
+        self._seq += 1  # -> odd: readers retry from here on
+        struct.pack_into("<Q", mm, 8, self._seq)
+        _FIXED.pack_into(
+            mm, _HEAD.size,
+            self.rank, int(nranks), os.getpid(), len(ed),
+            int(step) & 0xFFFFFFFFFFFFFFFF,
+            int(epoch) & 0xFFFFFFFFFFFFFFFF,
+            int(op_id) & 0xFFFFFFFFFFFFFFFF,
+            time.time(), time.monotonic(),
+            str(last_op).encode("utf-8", "replace")[:16],
+            float(led.get("deposits", 0.0)), float(led.get("collected", 0.0)),
+            float(led.get("drained", 0.0)), float(led.get("pending", 0.0)))
+        off = _HEAD.size + _FIXED.size
+        for peer, state, deadline in ed:
+            _EDGE.pack_into(mm, off, int(peer), int(state), float(deadline))
+            off += _EDGE.size
+        self._seq += 1  # -> even: page consistent again
+        struct.pack_into("<Q", mm, 8, self._seq)
+
+    def close(self, unlink: bool = False) -> None:
+        self._seg.close(unlink)
+
+
+def _decode(buf: bytes) -> Dict[str, object]:
+    magic, version, seq = _HEAD.unpack_from(buf, 0)
+    if magic != STATUS_MAGIC:
+        raise ValueError(f"not a status page (magic 0x{magic:08x})")
+    if version != STATUS_VERSION:
+        raise ValueError(f"unsupported status-page version {version}")
+    (rank, nranks, pid, n_edges, step, epoch, op_id, wall_ts, mono_ts,
+     last_op, dep, col, drn, pend) = _FIXED.unpack_from(buf, _HEAD.size)
+    edges: List[Dict[str, object]] = []
+    off = _HEAD.size + _FIXED.size
+    for _ in range(max(0, min(n_edges, MAX_EDGES))):
+        peer, state, deadline = _EDGE.unpack_from(buf, off)
+        off += _EDGE.size
+        edges.append({
+            "peer": peer,
+            "state": EDGE_STATE_NAMES.get(state, str(state)),
+            "deadline_s": deadline,
+        })
+    return {
+        "schema": STATUS_SCHEMA,
+        "version": version,
+        "seq": seq,
+        "rank": rank,
+        "nranks": nranks,
+        "pid": pid,
+        "step": step,
+        "epoch": epoch,
+        "op_id": op_id,
+        "last_op": last_op.split(b"\0", 1)[0].decode("utf-8", "replace"),
+        "wall_ts": wall_ts,
+        "mono_ts": mono_ts,
+        "ledger": {
+            "deposits": dep, "collected": col,
+            "drained": drn, "pending": pend,
+            "balance": dep - col - drn,
+        },
+        "edges": edges,
+    }
+
+
+def read_status_page(path: str, retries: int = 8) -> Dict[str, object]:
+    """Seqlock reader: two whole-page reads bracketing one seq — accept
+    the first buffer iff both seqs are the same even number; otherwise a
+    write was in flight, so retry.  Raises :class:`TornPageError` when
+    the page never settles (a stuck mid-write writer) and ``ValueError``
+    on a bad magic/version."""
+    last = None
+    for _ in range(max(1, retries)):
+        with open(path, "rb") as f:
+            buf1 = f.read(PAGE_BYTES)
+        if len(buf1) < _HEAD.size + _FIXED.size:
+            raise ValueError(f"truncated status page {path}")
+        seq1 = struct.unpack_from("<Q", buf1, 8)[0]
+        if seq1 % 2 == 0:
+            with open(path, "rb") as f:
+                buf2 = f.read(PAGE_BYTES)
+            seq2 = struct.unpack_from("<Q", buf2, 8)[0]
+            if seq1 == seq2:
+                return _decode(buf1)
+        last = seq1
+        time.sleep(0.001)
+    raise TornPageError(f"status page {path} torn across retries "
+                        f"(last seq {last})")
+
+
+def find_status_pages(job: str) -> Dict[int, str]:
+    """``{rank: path}`` of every status page the job has published (both
+    the shm dir and any configured fallback dir are searched)."""
+    prefix = shm_native.seg_name(job, "status_r")[1:]
+    out: Dict[int, str] = {}
+    for d in {"/dev/shm", shm_native._FALLBACK_DIR}:
+        for path in glob.glob(os.path.join(d, prefix + "*")):
+            tail = os.path.basename(path)[len(prefix):]
+            if tail.isdigit():
+                out[int(tail)] = path
+    return out
+
+
+def read_fleet(job: str) -> Dict[int, Dict[str, object]]:
+    """Every readable status page of the job; unreadable/torn pages map
+    to ``{"error": ...}`` instead of failing the whole attach."""
+    fleet: Dict[int, Dict[str, object]] = {}
+    for rank, path in sorted(find_status_pages(job).items()):
+        try:
+            fleet[rank] = read_status_page(path)
+        except (OSError, ValueError, TornPageError) as e:
+            fleet[rank] = {"error": f"{type(e).__name__}: {e}"}
+    return fleet
+
+
+def _read_holder_words(job: str) -> Dict[int, int]:
+    """``{mutex_rank: holder_rank}`` straight from the job's holder-board
+    segment, read-only (no segment is created when none exists)."""
+    path = os.path.join(shm_native._FALLBACK_DIR,
+                        shm_native.seg_name(job, "holders")[1:])
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError:
+        return {}
+    n = len(raw) // 8
+    out: Dict[int, int] = {}
+    for r in range(n):
+        word = struct.unpack_from("<Q", raw, r * 8)[0]
+        if 0 < word <= n:
+            out[r] = int(word) - 1
+    return out
+
+
+def collect(job: str) -> Dict[str, object]:
+    """One schema-valid fleet snapshot: the merged status pages plus the
+    current epoch's lock holders and the suspect summary — the payload
+    behind ``bftpu-top --once --json``."""
+    from bluefog_tpu.resilience.join import epoch_job
+
+    fleet = read_fleet(job)
+    epoch = max((int(p.get("epoch", 0)) for p in fleet.values()
+                 if "error" not in p), default=0)
+    # mutexes live in the CURRENT epoch's job segment; ranks mid-switch
+    # may still hold base-epoch locks, so merge both boards (epoch wins)
+    holders = _read_holder_words(job)
+    holders.update(_read_holder_words(epoch_job(job, epoch)))
+    suspects = sorted({e["peer"] for p in fleet.values()
+                       for e in p.get("edges", ())
+                       if e.get("state") == "suspect"})
+    return {
+        "schema": "bftpu-top/1",
+        "job": job,
+        "wall_ts": time.time(),
+        "epoch": epoch,
+        "ranks": {str(r): p for r, p in fleet.items()},
+        "holders": {str(m): h for m, h in sorted(holders.items())},
+        "suspects": suspects,
+    }
+
+
+# ---------------------------------------------------------------------------
+# runtime trace toggle: the tracectl word
+# ---------------------------------------------------------------------------
+
+TRACE_DEFAULT = 0  # whatever BFTPU_TRACING said at launch
+TRACE_OFF = 1
+TRACE_ON = 2
+_CTL = struct.Struct("<QQ")  # generation, mode
+
+
+def _tracectl_path(job: str) -> str:
+    return os.path.join(shm_native._FALLBACK_DIR,
+                        shm_native.seg_name(job, "tracectl")[1:])
+
+
+def read_trace_control(job: str) -> Tuple[int, int]:
+    """``(generation, mode)`` of the job's trace-control word (``(0,
+    TRACE_DEFAULT)`` when never published)."""
+    try:
+        with open(_tracectl_path(job), "rb") as f:
+            raw = f.read(_CTL.size)
+    except OSError:
+        return (0, TRACE_DEFAULT)
+    if len(raw) != _CTL.size:
+        return (0, TRACE_DEFAULT)
+    return _CTL.unpack(raw)
+
+
+def publish_trace_control(job: str, mode: int) -> int:
+    """Atomically publish a new trace mode (generation bump makes the
+    publish observable even when the mode repeats); returns the new
+    generation."""
+    gen = read_trace_control(job)[0] + 1
+    path = _tracectl_path(job)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(_CTL.pack(gen, int(mode)))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return gen
+
+
+class TraceControl:
+    """Worker-side poller: each rank checks the word at most every
+    ``interval`` seconds (amortized to ~nothing against a window op) and
+    applies a generation change by rebuilding the process tracer —
+    ``bftpu-top trace on`` therefore takes effect within one gossip
+    round, no restart."""
+
+    def __init__(self, job: str, rank: int, nranks: int,
+                 interval: float = 0.2):
+        self.job = str(job)
+        self.rank = int(rank)
+        self.nranks = int(nranks)
+        self._interval = float(interval)
+        # attach-time state is history, not a command: only generations
+        # published AFTER we start polling are applied
+        self._gen = read_trace_control(job)[0]
+        self._next_poll = 0.0
+
+    def poll(self) -> None:
+        now = time.monotonic()
+        if now < self._next_poll:
+            return
+        self._next_poll = now + self._interval
+        gen, mode = read_trace_control(self.job)
+        if gen == self._gen:
+            return
+        self._gen = gen
+        self._apply(mode)
+
+    def _apply(self, mode: int) -> None:
+        from bluefog_tpu.tracing import tracer as _tracing
+
+        if mode == TRACE_ON:
+            if _tracing.tracing_dir() is None:
+                os.environ["BFTPU_TRACING"] = "1"
+            _tracing.reset()
+            _tracing.get_tracer().set_identity(
+                self.rank, self.nranks, self.job)
+        elif mode == TRACE_OFF:
+            t = _tracing.get_tracer()
+            if t.enabled:
+                t.write_buffer()  # don't lose spans gathered while on
+            os.environ["BFTPU_TRACING"] = "0"
+            _tracing.reset()

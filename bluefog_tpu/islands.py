@@ -49,7 +49,11 @@ from bluefog_tpu.resilience import adaptive as _adaptive
 from bluefog_tpu.resilience import degraded as _degraded
 from bluefog_tpu.resilience import healing as _healing
 from bluefog_tpu.resilience import join as _join
-from bluefog_tpu.resilience.detector import EDGE_ALIVE, FailureDetector
+from bluefog_tpu.resilience.detector import (
+    _EDGE_STATE_CODE,
+    EDGE_ALIVE,
+    FailureDetector,
+)
 from bluefog_tpu.telemetry import registry as _telemetry
 from bluefog_tpu.timeline import timeline_context
 from bluefog_tpu.tracing import tracer as _tracing
@@ -204,6 +208,21 @@ class _IslandContext:
         self.demoted: set = set()
         self.base_edges: Optional[List[Tuple[int, int]]] = None
         _attach_edge_health(self)
+        # live introspection plane (bluefog_tpu.introspect): the status
+        # page and the trace-control poller are keyed by the STABLE
+        # identity (base job + global rank), so an attached bftpu-top
+        # survives the epoch switches adaptive demotions trigger
+        self.statuspage = None
+        self.tracectl = None
+        self.op_rounds = 0
+        if shm_native.statuspage_enabled():
+            from bluefog_tpu.introspect import statuspage as _statuspage
+
+            try:
+                self.statuspage = _statuspage.StatusPage(job, rank_)
+                self.tracectl = _statuspage.TraceControl(job, rank_, size_)
+            except OSError:
+                self.statuspage = None  # read-only shm dir: run blind
 
 
 def _trivial_graph() -> nx.DiGraph:
@@ -315,6 +334,9 @@ def shutdown(unlink: bool = False) -> None:
     names = list(ctx.created_names)
     ctx.windows.clear()
     ctx.shm_job.close(unlink=False)
+    if ctx.statuspage is not None:
+        ctx.statuspage.close(unlink=unlink)
+        ctx.statuspage = None
     hostmap = os.environ.get("BLUEFOG_ISLAND_HOSTMAP")
     if hostmap:
         from bluefog_tpu.native.routed_transport import parse_hostmap
@@ -751,6 +773,18 @@ def join(job: Optional[str] = None, timeout: Optional[float] = None):
     ctx.global_rank = grant.rank
     ctx.members_global = grant.members
     ctx.associated_p = bool(rec.get("associated_p", False))
+    if ctx.statuspage is not None:
+        # the context constructor keyed the page by (epoch job, local
+        # rank); re-key by the stable identity bftpu-top attaches under
+        from bluefog_tpu.introspect import statuspage as _statuspage
+
+        ctx.statuspage.close(unlink=True)
+        try:
+            ctx.statuspage = _statuspage.StatusPage(j, grant.rank)
+            ctx.tracectl = _statuspage.TraceControl(j, grant.rank,
+                                                   grant.size)
+        except OSError:
+            ctx.statuspage = None
     _context = ctx
     ctx.shm_job.barrier()  # aligns with _switch_epoch's first barrier
     sponsor_local = grant.sponsor_local
@@ -925,7 +959,10 @@ def adaptive_step():
             if g in ctx.members_global and g not in ctx.demoted
             and g != ctx.global_rank
             and ctx.members_global.index(g) not in ctx.dead
-            and pol.epoch_floor_open(g))
+            and pol.epoch_floor_open(g)
+            # with the tracing feed live, demotion needs gap staleness
+            # AND critical-path blame (pass-through when tracing is off)
+            and pol.corroborated(g))
         if cand:
             # never demote past a minority: every straggler needs a
             # healthy anchor and a majority-healthy core keeps the
@@ -1217,6 +1254,41 @@ def _note_op(op: str, name: str) -> None:
     :mod:`bluefog_tpu.windows`, which would pull jax into every island
     worker)."""
     _telemetry.note_op(op, name)
+
+
+def _statuspage_tick(ctx: "_IslandContext", name: str,
+                     op: str = "win_update") -> None:
+    """Republish my live status page (one seqlocked mmap write, no
+    locks/syscalls) and poll the trace-control word — the per-op
+    heartbeat of the introspection plane (:mod:`bluefog_tpu.introspect`).
+    No-op when ``BFTPU_STATUSPAGE=0``."""
+    page = ctx.statuspage
+    if page is None:
+        return
+    ctx.op_rounds += 1
+    pol = ctx.adaptive
+    deadline = (pol.gap_deadline_s() or 0.0) if pol is not None else 0.0
+    edges = []
+    for l, g in enumerate(ctx.members_global):
+        if g == ctx.global_rank:
+            continue
+        code = (_EDGE_STATE_CODE.get(pol.health.state(g), 0)
+                if pol is not None else 0)
+        if l in ctx.dead:
+            code = 2  # dead set outranks the edge machine's view
+        elif g in ctx.demoted:
+            code = 3
+        edges.append((g, code, deadline))
+    reg = _telemetry.get_registry()
+    ledger = _ledger_totals(reg) if reg.enabled else None
+    try:
+        page.publish(nranks=len(ctx.members_global), step=ctx.op_rounds,
+                     epoch=ctx.epoch, op_id=ctx.op_rounds,
+                     last_op=f"{op}:{name}", ledger=ledger, edges=edges)
+    except (OSError, ValueError):
+        pass  # a reaped segment must never fail the op itself
+    if ctx.tracectl is not None:
+        ctx.tracectl.poll()
 
 
 # ---------------------------------------------------------------------------
@@ -1514,6 +1586,11 @@ def win_update(
         # were force-drained and must not be combined (or even locked)
         nbrs = [s for s in win.in_neighbors if s in nw]
         win._last_absorbed = ()
+        if ctx.adaptive is not None:
+            # the corroboration gate follows the tracer's LIVE state (it
+            # can flip at runtime via bftpu-top): while tracing, demotion
+            # additionally needs critical-path blame — see corroborated()
+            ctx.adaptive.set_live_feed(tr.enabled)
         if ctx.adaptive is not None and nbrs:
             # round-local ABSORB on deadline-missed edges: a stale edge
             # is dropped from THIS combine only — its slot keeps its
@@ -1533,6 +1610,13 @@ def win_update(
                 nbrs = [s for s in nbrs if s in nw]
                 win._last_absorbed = tuple(
                     sorted(_peer_global(ctx, s) for s in stale))
+                if tr.enabled:
+                    # live critical-path attribution: a deadline-missed
+                    # in-edge is by construction the op this round
+                    # waited on — the rank-local form of the merged
+                    # trace's rounds-lengthened-by-rank
+                    for s in stale:
+                        ctx.adaptive.note_round_blame(_peer_global(ctx, s))
                 if reg.enabled:
                     reg.counter("adaptive.weight_absorbed").add(
                         dropped if convex else float(len(stale)))
@@ -1607,6 +1691,7 @@ def win_update(
                 tr.end(ttok, consume=consumes)
                 tr.advance_round()
             _note_op("win_update", name)
+            _statuspage_tick(ctx, name)
             out = win.self_tensor
             out = np.array(out, copy=True) if clone else out
             return _island_unpack(name, out)
@@ -1658,6 +1743,7 @@ def win_update(
             tr.end(ttok, consume=consumes)
             tr.advance_round()
         _note_op("win_update", name)
+        _statuspage_tick(ctx, name)
         out = win.self_tensor
         out = np.array(out, copy=True) if clone else out
         return _island_unpack(name, out)
@@ -1737,11 +1823,20 @@ def _mutex_acquire_deadline(ctx: "_IslandContext", r: int) -> None:
             on_timeout=on_timeout)
     except TypeError:
         ctx.shm_job.mutex_acquire(r)
-    if pol is not None and r != ctx.rank and r not in ctx.dead:
+    if pol is not None:
         # the convoy signal: a straggler asleep INSIDE its critical
         # section stalls this acquire long past the healthy-cadence
-        # baseline (acquires are never CLEAN evidence — see adaptive.py)
-        pol.note_acquire(_peer_global(ctx, r), time.monotonic() - t0)
+        # baseline (acquires are never CLEAN evidence — see adaptive.py).
+        # Blame the rank that actually HELD the lock during the wait
+        # (the transport's holder word) when available; the window
+        # owner is the fallback attribution.
+        blame = r
+        h = getattr(ctx.shm_job, "last_wait_holder", None)
+        if h is not None and 0 <= h < ctx.size:
+            blame = h
+        if blame != ctx.rank and blame not in ctx.dead:
+            pol.note_acquire(_peer_global(ctx, blame),
+                             time.monotonic() - t0)
 
 
 def win_associated_p(name: str) -> float:

@@ -38,24 +38,61 @@ from bluefog_tpu.telemetry import registry as _telemetry
 _DTYPE_CODES = {np.dtype(np.float32): 1, np.dtype(np.float64): 2}
 
 
-def _timed_mutex_acquire(acquire, rank: int, timeout: Optional[float]):
+#: A mutex wait is "contended" (worth a per-holder counter + trace
+#: instant) past this many nanoseconds; uncontended acquires stay on the
+#: aggregate counters only, so the hot path adds no label lookups.
+_CONTENDED_WAIT_NS = 1_000_000
+
+
+def _timed_mutex_acquire(acquire, rank: int, timeout: Optional[float],
+                         holders=None, me: int = -1):
     """Run a transport's raw mutex acquire under telemetry timing: total
     wall nanoseconds spent waiting (``shm.mutex_wait_ns``), acquire count,
     and timeout count — the contention signals docs/OBSERVABILITY.md
-    points at when win_mutex latency climbs."""
+    points at when win_mutex latency climbs.
+
+    With a ``holders`` board (:class:`HolderBoard`) the wait additionally
+    attributes to the *current holder* — the rank whose release we are
+    actually waiting on, which under lock-all gossip is usually NOT the
+    window owner ``rank``: the holder word is sampled at wait start, a
+    contended wait bumps ``shm.mutex_wait_by_holder{holder=..}`` and
+    emits a ``mutex_wait`` trace instant carrying the holder rank, and
+    the board is stamped with ``me`` after a successful acquire.
+    Returns the holder rank observed at wait start (None when free,
+    unknown, or it was us)."""
+    observed = None
+    if holders is not None:
+        h = holders.holder(rank)
+        if h is not None and h != me:
+            observed = h
     reg = _telemetry.get_registry()
-    if not reg.enabled:
-        return acquire(rank, timeout)
+    if not reg.enabled and holders is None:
+        acquire(rank, timeout)
+        return None
     t0 = time.perf_counter_ns()
     try:
-        return acquire(rank, timeout)
+        acquire(rank, timeout)
     except TimeoutError:
-        reg.counter("shm.mutex_timeouts").inc()
+        if reg.enabled:
+            reg.counter("shm.mutex_timeouts").inc()
         raise
     finally:
-        reg.counter("shm.mutex_wait_ns").add(
-            time.perf_counter_ns() - t0)
-        reg.counter("shm.mutex_acquires").inc()
+        wait_ns = time.perf_counter_ns() - t0
+        if reg.enabled:
+            reg.counter("shm.mutex_wait_ns").add(wait_ns)
+            reg.counter("shm.mutex_acquires").inc()
+        if observed is not None and wait_ns >= _CONTENDED_WAIT_NS:
+            if reg.enabled:
+                reg.counter("shm.mutex_wait_by_holder",
+                            holder=observed).inc()
+            from bluefog_tpu.tracing import tracer as _tracing
+
+            tr = _tracing.get_tracer()
+            if tr.enabled:
+                tr.instant("mutex_wait", aux=int(observed))
+    if holders is not None:
+        holders.set_holder(rank, me)
+    return observed
 
 
 def _deposit_counters(obj, reg):
@@ -218,6 +255,11 @@ class NativeShmJob:
         self._h = lib.bf_shm_job_create(self._name.encode(), rank, nranks)
         if not self._h:
             raise RuntimeError(f"could not create shm job segment {self._name}")
+        self._holders = _maybe_holder_board(job, nranks)
+        #: holder rank observed at the start of the last mutex_acquire wait
+        #: (None = lock was free / board off) — islands' deadline acquire
+        #: reads this to blame the *holder* instead of the window owner.
+        self.last_wait_holder = None
 
     def barrier(self, timeout: Optional[float] = None) -> None:
         """Sense-reversing barrier.  With ``timeout`` (seconds) the wait is
@@ -245,7 +287,9 @@ class NativeShmJob:
 
     def mutex_acquire(self, rank: int,
                       timeout: Optional[float] = None) -> None:
-        _timed_mutex_acquire(self._mutex_acquire_raw, rank, timeout)
+        self.last_wait_holder = _timed_mutex_acquire(
+            self._mutex_acquire_raw, rank, timeout,
+            holders=self._holders, me=self.rank)
 
     def _mutex_acquire_raw(self, rank: int,
                            timeout: Optional[float]) -> None:
@@ -261,15 +305,29 @@ class NativeShmJob:
     def mutex_break(self, rank: int) -> None:
         """Forcibly release a mutex whose holder the failure detector has
         declared dead."""
+        if self._holders is not None:
+            self._holders.clear(int(rank))  # unconditional: holder is dead
         self._lib.bf_shm_job_mutex_break(self._h, int(rank))
 
     def mutex_release(self, rank: int) -> None:
+        if self._holders is not None:
+            # clear BEFORE the release: once the lock is free a nonzero
+            # word must never name us (conditional — a racing break wins)
+            self._holders.clear(int(rank), self.rank)
         self._lib.bf_shm_job_mutex_release(self._h, int(rank))
+
+    def mutex_holder(self, rank: int) -> Optional[int]:
+        """Advisory current holder of a job mutex (None when free or the
+        holder board is off)."""
+        return None if self._holders is None else self._holders.holder(rank)
 
     def close(self, unlink: bool = False) -> None:
         if self._h:
             self._lib.bf_shm_job_destroy(self._h, 1 if unlink else 0)
             self._h = None
+        if self._holders is not None:
+            self._holders.close(unlink)
+            self._holders = None
 
     def __del__(self):
         try:
@@ -811,13 +869,97 @@ class TraceSidecar:
 def _maybe_trace_sidecar(job: str, name: str, rank: int, nranks: int,
                          maxd: int):
     """A window's trace sidecar when tracing is enabled, else None (the
-    window's trace_stamp/trace_peek become no-ops)."""
+    window's trace_stamp/trace_peek become no-ops).
+
+    Also created (it is a tiny segment) when the introspection plane is
+    on, so flipping ``BFTPU_TRACING`` at runtime via ``bftpu-top`` finds
+    the flow-arrow words already wired — windows are built once at
+    win_create and cannot grow a sidecar later."""
     from bluefog_tpu.tracing.tracer import tracing_dir
 
-    if tracing_dir() is None:
+    if tracing_dir() is None and not statuspage_enabled():
         return None
     try:
         return TraceSidecar(job, name, rank, nranks, maxd)
+    except OSError:
+        return None
+
+
+def statuspage_enabled() -> bool:
+    """Whether the live-introspection plane (per-rank status pages + the
+    mutex holder board) is on.  Default ON — the point of the plane is
+    that a job is attachable *before* anyone knew it would misbehave;
+    ``BFTPU_STATUSPAGE=0`` opts out (bench.py gates the cost < 2%)."""
+    return os.environ.get("BFTPU_STATUSPAGE", "1") not in ("0", "", "false")
+
+
+class HolderBoard:
+    """One aligned u64 *holder word* per job mutex, in a sidecar segment
+    (``bf_<job>_holders``) next to the job segment — the native C struct
+    is not extensible without recompiling shm_mailbox.cc.
+
+    Word value is ``holder_rank + 1`` (0 = free), stamped by the winner
+    right AFTER its raw acquire and cleared right BEFORE its release, so
+    a nonzero word is only ever a rank that really holds (or held a
+    heartbeat ago) the lock.  Like the trace sidecar the word is advisory
+    and lock-free: a torn/stale read costs one wait mis-attribution,
+    never correctness, so waiters sample it without synchronizing and
+    ``bftpu-top`` mmaps it read-only from outside the job."""
+
+    def __init__(self, job: str, nranks: int):
+        self.nranks = int(nranks)
+        path = os.path.join(_FALLBACK_DIR, seg_name(job, "holders")[1:])
+        self._seg = _FallbackSegment(path, max(1, self.nranks) * 8)
+
+    def set_holder(self, mutex_rank: int, holder_rank: int) -> None:
+        if 0 <= int(mutex_rank) < self.nranks:
+            struct.pack_into("<Q", self._seg._mm, int(mutex_rank) * 8,
+                             (int(holder_rank) + 1) & 0xFFFFFFFFFFFFFFFF)
+
+    def clear(self, mutex_rank: int,
+              holder_rank: Optional[int] = None) -> None:
+        """Zero a holder word; with ``holder_rank`` the clear is
+        conditional (only if we are the recorded holder), so a release
+        racing a ``mutex_break`` never erases the breaker's view."""
+        if not 0 <= int(mutex_rank) < self.nranks:
+            return
+        off = int(mutex_rank) * 8
+        if holder_rank is not None:
+            cur = struct.unpack_from("<Q", self._seg._mm, off)[0]
+            if cur != int(holder_rank) + 1:
+                return
+        struct.pack_into("<Q", self._seg._mm, off, 0)
+
+    def holder(self, mutex_rank: int) -> Optional[int]:
+        """Current holder rank of a mutex, or None when free/unknown."""
+        if not 0 <= int(mutex_rank) < self.nranks:
+            return None
+        word = struct.unpack_from(
+            "<Q", self._seg._mm, int(mutex_rank) * 8)[0]
+        if word == 0 or word > self.nranks:
+            return None
+        return int(word) - 1
+
+    def snapshot(self):
+        """``{mutex_rank: holder_rank}`` for every currently-held word."""
+        out = {}
+        for r in range(self.nranks):
+            h = self.holder(r)
+            if h is not None:
+                out[r] = h
+        return out
+
+    def close(self, unlink: bool = False) -> None:
+        self._seg.close(unlink)
+
+
+def _maybe_holder_board(job: str, nranks: int):
+    """The job's holder board when introspection is on, else None (mutex
+    waits fall back to owner-rank attribution)."""
+    if not statuspage_enabled():
+        return None
+    try:
+        return HolderBoard(job, nranks)
     except OSError:
         return None
 
@@ -832,6 +974,8 @@ class FallbackShmJob:
         self.nranks = nranks
         path = os.path.join(_FALLBACK_DIR, seg_name(job, "job")[1:])
         self._seg = _FallbackSegment(path, 16 + nranks + 8 * nranks)
+        self._holders = _maybe_holder_board(job, nranks)
+        self.last_wait_holder = None  # see NativeShmJob
 
     def _beat_off(self, rank: int) -> int:
         return 16 + self.nranks + 8 * rank
@@ -882,7 +1026,9 @@ class FallbackShmJob:
 
     def mutex_acquire(self, rank: int,
                       timeout: Optional[float] = None) -> None:
-        _timed_mutex_acquire(self._mutex_acquire_raw, rank, timeout)
+        self.last_wait_holder = _timed_mutex_acquire(
+            self._mutex_acquire_raw, rank, timeout,
+            holders=self._holders, me=self.rank)
 
     def _mutex_acquire_raw(self, rank: int,
                            timeout: Optional[float]) -> None:
@@ -905,14 +1051,24 @@ class FallbackShmJob:
                 time.sleep(0.0005)
 
     def mutex_break(self, rank: int) -> None:
-        # lockf ranges die with their holder process — nothing to break
-        pass
+        # lockf ranges die with their holder process — nothing to break,
+        # but the advisory holder word outlives the holder and must go
+        if self._holders is not None:
+            self._holders.clear(int(rank))
 
     def mutex_release(self, rank: int) -> None:
+        if self._holders is not None:
+            self._holders.clear(int(rank), self.rank)
         self._seg.unlock(16 + rank, 1)
+
+    def mutex_holder(self, rank: int) -> Optional[int]:
+        return None if self._holders is None else self._holders.holder(rank)
 
     def close(self, unlink: bool = False) -> None:
         self._seg.close(unlink)
+        if self._holders is not None:
+            self._holders.close(unlink)
+            self._holders = None
 
 
 class FallbackShmWindow:

@@ -228,6 +228,8 @@ def ring_flash_attention(
     perm = tuple((i, (i + 1) % n) for i in range(n))
 
     def flash(q_, kb_, vb_, *, q_start, k_start, causal_):
+        q_start = jnp.asarray(q_start, jnp.float32).reshape(1)
+        k_start = jnp.asarray(k_start, jnp.float32).reshape(1)
         return flash_attention_with_lse(
             q_, kb_, vb_, q_start=q_start, k_start=k_start, causal=causal_,
             block_q=block_q, block_k=block_k, interpret=interpret, impl=impl,
@@ -248,11 +250,6 @@ def ring_flash_attention(
     def visible_hop(ops):
         return flash(*ops, q_start=0, k_start=0, causal_=False)
 
-    stripe0_hop = diag_hop  # key stripe index <= ours: tril including diag
-
-    def stripe1_hop(ops):  # key stripe index > ours: strict lower triangle
-        return flash(*ops, q_start=0, k_start=1, causal_=True)
-
     o = None
     lse = None
     kv = (k, v)
@@ -261,12 +258,16 @@ def ring_flash_attention(
         j = (idx - step) % n  # global index of the key block held this step
         if striped and causal and tq == tk:
             # striped layout: token (i, stripe j) has global pos i*n + j,
-            # so visibility vs our stripe idx depends only on j <= idx
-            o_s, lse_s = (
-                stripe0_hop((q, kb, vb)) if step == 0 else lax.cond(
-                    j <= idx, stripe0_hop, stripe1_hop, (q, kb, vb)
-                )
-            )
+            # so visibility vs our stripe idx depends only on j <= idx.
+            # One flash call with a traced 0/1 key offset instead of a
+            # lax.cond between two static-offset calls: the cond's
+            # transpose hoists the branches' scalar offset constants to
+            # the shard_map boundary, where their (zero) cotangents fail
+            # jax-0.4.x's rep checking — the same class of failure the
+            # tp/pipeline blocks hit (docs/STATUS.md rounds 11-12)
+            delta = 0 if step == 0 else jnp.where(j <= idx, 0, 1)
+            o_s, lse_s = flash(q, kb, vb, q_start=0, k_start=delta,
+                               causal_=True)
         elif causal and tq == tk:
             o_s, lse_s = _causal_hop_dispatch(
                 step, idx, diag_hop, visible_hop, masked_hop, (q, kb, vb)

@@ -134,6 +134,11 @@ class AdaptivePolicy:
         # even if per-member machine states diverge, no peer's epoch
         # standing may flap faster than the hysteresis floor)
         self._epoch_changed: dict = {}
+        # critical-path corroboration (tracing feed): peer -> count of
+        # rounds the peer's edge lengthened.  Only consulted while the
+        # feed is live — see corroborated().
+        self._cp_live = False
+        self._cp_blame: dict = {}
 
     # -- deadlines ---------------------------------------------------------
 
@@ -211,13 +216,17 @@ class AdaptivePolicy:
         seconds.  Returns True when that is past the acquire deadline
         (a miss).  Never counts as clean — see module docstring.
 
-        Attribution caveat: the transport exposes no holder word, so a
-        slow acquire blames the rank whose WINDOW is contended, which
-        may be an innocent neighbor of the real straggler.  The streak
-        machinery absorbs the error: an innocent rank keeps depositing,
-        and every fresh deposit resets its miss streak — only a rank
-        that both misses and produces nothing accumulates the
-        ``suspect_misses`` consecutive misses a demotion needs."""
+        Attribution: the shm transports keep an acquire-time holder
+        word (``HolderBoard`` in shm_native), so the islands caller
+        passes the rank that actually HELD the lock during the wait —
+        a straggler asleep inside its critical section is blamed
+        directly, not the innocent owner of the contended window.  On
+        transports without the board (TCP/routed) the caller falls back
+        to the window owner, and the streak machinery absorbs the
+        error: an innocent rank keeps depositing, and every fresh
+        deposit resets its miss streak — only a rank that both misses
+        and produces nothing accumulates the ``suspect_misses``
+        consecutive misses a demotion needs."""
         d = self.acquire_deadline_s()
         with self._lock:
             self._acq.observe(float(dur_s))
@@ -229,3 +238,47 @@ class AdaptivePolicy:
         if reg.enabled:
             reg.counter("adaptive.acquire_misses").inc()
         return True
+
+    # -- critical-path corroboration (tracing feed) ------------------------
+
+    def set_live_feed(self, active: bool) -> None:
+        """Whether the tracer is currently live (the caller checks each
+        round — tracing can be flipped at runtime via ``bftpu-top``).
+        While live, :meth:`corroborated` requires critical-path blame;
+        while off, it passes everything through (gap staleness alone
+        decides, exactly the PR-8 behavior)."""
+        self._cp_live = bool(active)
+
+    def note_round_blame(self, peer: int, n: int = 1) -> None:
+        """``peer``'s edge lengthened ``n`` of my rounds — the live,
+        rank-local form of the tracer's per-round critical-path
+        attribution (a deadline-missed in-edge is by construction the
+        op my round waited on).  Monotone: counts only accumulate."""
+        p = int(peer)
+        self._cp_blame[p] = self._cp_blame.get(p, 0) + max(0, int(n))
+        reg = _telemetry.get_registry()
+        if reg.enabled:
+            reg.counter("adaptive.cp_blame", peer=p).inc(max(0, int(n)))
+
+    def feed_critical_path(self, rounds_lengthened_by_rank) -> None:
+        """Merge a merged-trace attribution map (``tracing
+        --critical-path``'s ``rounds_lengthened_by_rank``) into the
+        blame counts.  Max-merge per rank keeps the feed monotone when
+        the same trace window is fed twice."""
+        for peer, count in dict(rounds_lengthened_by_rank).items():
+            p = int(peer)
+            self._cp_blame[p] = max(self._cp_blame.get(p, 0), int(count))
+
+    def critical_path_blame(self, peer: int) -> int:
+        """Rounds ``peer`` is currently blamed for lengthening."""
+        return int(self._cp_blame.get(int(peer), 0))
+
+    def corroborated(self, peer: int) -> bool:
+        """The demote AND-gate: with the tracing feed live, a suspect
+        may only be demoted when the critical path also blames it — a
+        rank can go gap-stale from MY vantage (a convoy, a dropped
+        deposit) without ever lengthening a round, and demoting it
+        would re-route gossip around a healthy member.  With the feed
+        off this is a pass-through, not a veto: staleness alone
+        decides, as before the feed existed."""
+        return (not self._cp_live) or self.critical_path_blame(peer) >= 1

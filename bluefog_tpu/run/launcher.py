@@ -398,10 +398,12 @@ def _respawn_budget() -> int:
 
 class _Control:
     """Line-JSON control server on a unix socket: ``scale`` enqueues
-    extra joiner ranks, ``status`` reports the fleet.  Handlers only
+    extra joiner ranks, ``status``/``top`` report the fleet, ``trace``
+    publishes the runtime trace-control word.  Handlers only
     enqueue/read — the supervisor loop owns all process state."""
 
     def __init__(self, job: str, state: dict):
+        self.job = job
         self.path = control_sock_path(job)
         try:
             os.unlink(self.path)
@@ -429,6 +431,24 @@ class _Control:
                 return {"ok": True, "live": st["live"],
                         "joiners": st["joiners"],
                         "pending_scale": st["scale_requests"]}
+        if cmd == "top":
+            # launcher-side half of the bftpu-top view; the client merges
+            # this with the shm status pages it reads directly
+            with st["lock"]:
+                return {"ok": True, "job": self.job, "live": st["live"],
+                        "joiners": st["joiners"],
+                        "pending_scale": st["scale_requests"]}
+        if cmd == "trace":
+            from bluefog_tpu.introspect import statuspage as _sp
+
+            mode = {"on": _sp.TRACE_ON, "off": _sp.TRACE_OFF,
+                    "default": _sp.TRACE_DEFAULT}.get(req.get("mode"))
+            if mode is None:
+                return {"ok": False,
+                        "error": f"trace mode must be on|off|default, "
+                                 f"got {req.get('mode')!r}"}
+            gen = _sp.publish_trace_control(self.job, mode)
+            return {"ok": True, "mode": req["mode"], "generation": gen}
         return {"ok": False, "error": f"unknown command {cmd!r}"}
 
     def _loop(self):
@@ -460,10 +480,43 @@ class _Control:
 
 
 def attach_main(job: str, command) -> int:
-    """``bftpu-run --attach JOB [scale +K | status]`` — the client side
-    of the control socket."""
+    """``bftpu-run --attach JOB [scale +K | status | top .. | trace ..]``
+    — the client side of the control socket (``top`` additionally reads
+    the shm status pages directly; see ``python -m
+    bluefog_tpu.introspect``)."""
     if not command:
         command = ["status"]
+    if command[0] == "top":
+        from bluefog_tpu.introspect.__main__ import main as top_main
+
+        return top_main(["--job", job] + list(command[1:]))
+    if command[0] == "trace":
+        if len(command) < 2 or command[1] not in ("on", "off", "default"):
+            print("bftpu-run: trace needs a mode: trace on|off|default",
+                  file=sys.stderr)
+            return 2
+        req = {"cmd": "trace", "mode": command[1]}
+        path = control_sock_path(job)
+        try:
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.connect(path)
+            s.sendall((json.dumps(req) + "\n").encode())
+            line = s.makefile("r").readline()
+            s.close()
+            print(line.strip())
+            return 0 if json.loads(line).get("ok") else 1
+        except (OSError, ValueError):
+            # no launcher (e.g. the job was spawned in-process): publish
+            # the trace-control word directly — workers poll the word,
+            # not the socket
+            from bluefog_tpu.introspect import statuspage as _sp
+
+            mode = {"on": _sp.TRACE_ON, "off": _sp.TRACE_OFF,
+                    "default": _sp.TRACE_DEFAULT}[command[1]]
+            gen = _sp.publish_trace_control(job, mode)
+            print(json.dumps({"ok": True, "mode": command[1],
+                              "generation": gen, "via": "word"}))
+            return 0
     if command[0] == "scale":
         if len(command) < 2:
             print("bftpu-run: scale needs a count: scale +K",
@@ -480,7 +533,8 @@ def attach_main(job: str, command) -> int:
         req = {"cmd": "status"}
     else:
         print(f"bftpu-run: unknown control command {command[0]!r} "
-              "(expected: scale +K, status)", file=sys.stderr)
+              "(expected: scale +K, status, top, trace on|off|default)",
+              file=sys.stderr)
         return 2
     path = control_sock_path(job)
     try:
@@ -602,7 +656,9 @@ def main(argv=None) -> int:
         metavar="JOB",
         help="dial a running islands job's control socket instead of "
         "launching: `bftpu-run --attach JOB scale +K` admits K extra "
-        "ranks, `... status` reports the fleet",
+        "ranks, `... status` reports the fleet, `... top` opens the "
+        "live bftpu-top view, `... trace on|off` toggles tracing at "
+        "runtime",
     )
     parser.add_argument("--timeline", default=None, help="write a Chrome trace here")
     parser.add_argument("-v", "--verbose", action="store_true")

@@ -35,6 +35,8 @@ from bluefog_tpu.telemetry.registry import (
     Registry,
     add_op_listener,
     get_registry,
+    journal_max_bytes,
+    journal_paths,
     note_op,
     read_journal,
     remove_op_listener,
@@ -68,6 +70,8 @@ __all__ = [
     "reset",
     "telemetry_dir",
     "read_journal",
+    "journal_paths",
+    "journal_max_bytes",
     "note_op",
     "add_op_listener",
     "remove_op_listener",

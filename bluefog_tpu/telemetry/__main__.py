@@ -54,11 +54,15 @@ def main(argv: List[str] = None) -> int:
 
     paths = find_snapshots(args.paths or _default_paths())
     snaps = []
+    skipped = []
     for p in paths:
         try:
             snap = load_snapshot(p)
         except (OSError, ValueError) as e:
+            # a SIGKILLed rank leaves a truncated/partial snapshot:
+            # merge what the survivors wrote instead of dying mid-merge
             print(f"warning: skipping {p}: {e}", file=sys.stderr)
+            skipped.append(p)
             continue
         if snap is not None:
             snaps.append(snap)
@@ -98,7 +102,13 @@ def main(argv: List[str] = None) -> int:
         for f in findings:
             print(f"CHECK {f.severity}: [{f.rule}] {f.subject}: {f.message}",
                   file=sys.stderr)
-        if findings:
+        if skipped:
+            # an unreadable rank means the corpus (and thus the ledger
+            # verdict) is incomplete — note it and fail the check
+            print(f"CHECK warning: [telemetry.merge-skipped] "
+                  f"{len(skipped)} snapshot(s) unreadable/truncated: "
+                  f"{', '.join(skipped)}", file=sys.stderr)
+        if findings or skipped:
             rc = 1
         else:
             led = merged["ledger"]

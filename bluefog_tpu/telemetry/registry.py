@@ -57,6 +57,8 @@ __all__ = [
     "get_registry",
     "reset",
     "read_journal",
+    "journal_paths",
+    "journal_max_bytes",
     "note_op",
     "add_op_listener",
     "remove_op_listener",
@@ -304,6 +306,8 @@ class Registry:
         self._lock = threading.Lock()
         self._journal_fh = None
         self._journal_lock = threading.Lock()
+        self._journal_bytes = 0
+        self._journal_max_bytes = journal_max_bytes()
         self._mono0 = time.monotonic()
         if timeline_sampling is None:
             timeline_sampling = bool(os.environ.get("BLUEFOG_TIMELINE"))
@@ -395,8 +399,29 @@ class Registry:
             if self._journal_fh is None:
                 os.makedirs(self.out_dir, exist_ok=True)
                 self._journal_fh = open(path, "a", encoding="utf-8")
+                try:
+                    self._journal_bytes = os.path.getsize(path)
+                except OSError:
+                    self._journal_bytes = 0
+            if (self._journal_max_bytes > 0
+                    and self._journal_bytes + len(line)
+                    > self._journal_max_bytes
+                    and self._journal_bytes > 0):
+                # size-capped rotation (BFTPU_JOURNAL_MAX_MB): the
+                # current file becomes <path>.1 (one generation — high-N
+                # fleets bound disk at ~2x the cap per rank) and the
+                # write lands in a fresh file.  Readers consult
+                # journal_paths() so rotated events still merge.
+                self._journal_fh.close()
+                try:
+                    os.replace(path, path + ".1")
+                except OSError:
+                    pass
+                self._journal_fh = open(path, "a", encoding="utf-8")
+                self._journal_bytes = 0
             self._journal_fh.write(line)
             self._journal_fh.flush()
+            self._journal_bytes += len(line)
 
     # -- snapshots ---------------------------------------------------------
     def snapshot(self) -> dict:
@@ -522,6 +547,24 @@ def reset() -> None:
         if _global is not None:
             _global.close()
         _global = None
+
+
+def journal_max_bytes() -> int:
+    """Per-rank journal size cap in bytes (``BFTPU_JOURNAL_MAX_MB``;
+    unset/0 = unlimited).  Past the cap the live file rotates to
+    ``<path>.1`` — see :meth:`Registry.journal`."""
+    try:
+        mb = float(os.environ.get("BFTPU_JOURNAL_MAX_MB", "0"))
+    except ValueError:
+        return 0
+    return int(mb * 1024 * 1024) if mb > 0 else 0
+
+
+def journal_paths(path: str) -> List[str]:
+    """All existing files of one rank's journal, oldest first — the
+    rotated generation (``<path>.1``) before the live file, so a
+    chronological reader just concatenates."""
+    return [p for p in (path + ".1", path) if os.path.exists(p)]
 
 
 def read_journal(path: str) -> Tuple[List[dict], int]:

@@ -47,6 +47,7 @@ def _load_journals(paths: List[str]):
     import re
 
     from bluefog_tpu.telemetry import read_journal
+    from bluefog_tpu.telemetry.registry import journal_paths
 
     journals = {}
     for p in paths:
@@ -56,8 +57,11 @@ def _load_journals(paths: List[str]):
             m = re.search(r"-r(\d+)\.events\.jsonl$", jp)
             if not m:
                 continue
-            events, _bad = read_journal(jp)
-            journals.setdefault(int(m.group(1)), []).extend(events)
+            # journal_paths folds in the rotated generation (<path>.1,
+            # BFTPU_JOURNAL_MAX_MB) ahead of the live file
+            for part in journal_paths(jp):
+                events, _bad = read_journal(part)
+                journals.setdefault(int(m.group(1)), []).extend(events)
     return journals
 
 
