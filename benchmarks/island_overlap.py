@@ -86,16 +86,137 @@ def _worker(rank, size, steps, mb, inner):
     return out
 
 
+def _worker_hidden(rank, size, rounds, mb, inner):
+    """Interleaved sync/async arms for ``overlap_hidden_pct``: what
+    fraction of the win-op latency the progress engine hides from the
+    caller.  Per round the sync arm times the blocking ``win_put`` +
+    ``win_update`` pair; the async arm times only the caller-visible
+    slice of the same pair through the engine — the submit calls plus
+    the post-step handle wait — with the jitted train step between them
+    (jit releases the GIL, so the worker drains while it runs).  The
+    arms alternate within one session, so scheduler drift cancels."""
+    import jax
+    import jax.numpy as jnp
+
+    from bluefog_tpu import islands, topology_util
+    from bluefog_tpu.telemetry import registry as _telemetry
+
+    islands.set_topology(topology_util.RingGraph(size))
+    elems = max(int(mb * 1e6 / 4), 1)
+    w = jnp.zeros((elems,), jnp.float32)
+    dim = 1024
+    x = jnp.ones((dim, dim), jnp.float32) * 1e-3
+    my_inner = inner if rank == 0 else 1
+
+    @jax.jit
+    def train_step(w, x):
+        def body(_, y):
+            return jnp.tanh(y @ x)
+
+        y = jax.lax.fori_loop(0, my_inner, body, x)
+        return w + y[0, 0] * 1e-6
+
+    islands.win_create(np.zeros(elems, np.float32), "hid")
+    w = train_step(w, x)
+    w.block_until_ready()  # compile before timing
+    islands.win_put(w, "hid")
+    islands.win_update("hid")
+    islands.barrier()
+
+    sync_s, blocked_s, step_s = [], [], []
+    for _ in range(rounds):
+        # sync arm: the full blocking op pair
+        w = train_step(w, x)
+        w.block_until_ready()
+        t0 = time.perf_counter()
+        islands.win_put(w, "hid")
+        islands.win_update("hid")
+        sync_s.append(time.perf_counter() - t0)
+        # async arm: submit, step, then wait out whatever is left
+        t0 = time.perf_counter()
+        hp = islands.win_put_async(w, "hid")
+        hu = islands.win_update_async("hid")
+        submit = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        w2 = train_step(w, x)
+        w2.block_until_ready()
+        step_s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        hp.wait(60)
+        hu.wait(60)
+        blocked_s.append(submit + time.perf_counter() - t0)
+        w = w2
+    islands.barrier()
+    eng = islands.progress_engine()
+    stats = eng.stats() if eng is not None else {}
+    reg = _telemetry.get_registry()
+    saved = (int(reg.counter("progress.staging_bytes_saved").value)
+             if reg.enabled else -1)
+    islands.win_free("hid")
+    sync = float(np.median(sync_s))
+    blocked = float(np.median(blocked_s))
+    return {
+        "sync_op_ms": round(sync * 1e3, 3),
+        "async_blocked_ms": round(blocked * 1e3, 3),
+        "step_ms": round(float(np.median(step_s)) * 1e3, 2),
+        "hidden_pct": round((1.0 - blocked / sync) * 100.0, 1)
+        if sync > 0 else 0.0,
+        "params_m": round(elems / 1e6, 1),
+        "staging_bytes_saved": saved,
+        "engine": stats,
+    }
+
+
+def measure_overlap_hidden(nprocs=2, rounds=12, mb=16.0, inner=60):
+    """bench.py phase: ``overlap_hidden_pct`` headline (gate >= 90)."""
+    from bluefog_tpu import islands
+
+    prev = os.environ.get("BFTPU_TELEMETRY")
+    os.environ["BFTPU_TELEMETRY"] = "1"  # children inherit: the
+    # staging_bytes_saved counter is part of the acceptance evidence
+    try:
+        res = islands.spawn(_worker_hidden, nprocs,
+                            args=(rounds, mb, inner), timeout=900.0)
+    finally:
+        if prev is None:
+            os.environ.pop("BFTPU_TELEMETRY", None)
+        else:
+            os.environ["BFTPU_TELEMETRY"] = prev
+    r0 = res[0]
+    return {
+        "metric": "win-op latency hidden by the progress engine "
+                  "(rank0, caller-visible blocked time vs sync op)",
+        "value": r0["hidden_pct"],
+        "unit": "%",
+        "sync_op_ms": r0["sync_op_ms"],
+        "async_blocked_ms": r0["async_blocked_ms"],
+        "step_ms": r0["step_ms"],
+        "payload_params_m": r0["params_m"],
+        "staging_bytes_saved": r0["staging_bytes_saved"],
+        "fused_batches": r0["engine"].get("fused_batches", 0),
+        "rounds": rounds,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--mb", type=float, default=16.0)
     ap.add_argument("--inner", type=int, default=200,
                     help="matmul iterations per step on rank 0")
+    ap.add_argument("--hidden", action="store_true",
+                    help="run the overlap_hidden_pct arms instead of the "
+                    "optimizer step-time comparison")
     args = ap.parse_args()
 
     from bluefog_tpu import islands
     from bluefog_tpu.native import shm_native
+
+    if args.hidden:
+        print(json.dumps(measure_overlap_hidden(
+            2, rounds=max(args.steps // 2, 4), mb=args.mb,
+            inner=args.inner)))
+        return
 
     res = islands.spawn(
         _worker, 2, args=(args.steps, args.mb, args.inner), timeout=900.0)

@@ -30,6 +30,11 @@ consumers (CLI, pytest, CI):
   retained at degree one, restores round-trip to the pre-demotion W,
   and the driven EdgeHealth machine admits no demote/promote cycle
   shorter than the hysteresis floor;
+- **progress** (:mod:`.progress_rules`) — the async progress engine:
+  exhaustive submit/step/quiesce/resume interleavings on a real
+  manual-mode engine (exactly-once handles, order-preserving fusion,
+  nothing executes while parked), handle-lifecycle trace lint, and the
+  fusion-batch contiguity/budget contract;
 - **introspect** (:mod:`.introspect_rules`) — the live introspection
   plane: status pages read back schema-exact, settled, and
   ledger-consistent; mutex holder words always name a live member and
@@ -62,6 +67,7 @@ from bluefog_tpu.analysis import (  # noqa: F401
     hlo_rules,
     introspect_rules,
     plan_rules,
+    progress_rules,
     resilience_rules,
     seqlock_model,
     telemetry_rules,

@@ -31,6 +31,7 @@ from bluefog_tpu.analysis import (
     hlo_rules,
     introspect_rules,
     plan_rules,
+    progress_rules,
     resilience_rules,
     seqlock_model,
     telemetry_rules,
@@ -481,6 +482,51 @@ def _introspect_blame_regression() -> List[Finding]:
         [first, second], "fixture[blame-regression]")
 
 
+# ---------------------------------------------------------------------------
+# progress fixtures: a broken engine variant + seeded bad traces
+# ---------------------------------------------------------------------------
+
+
+def _progress_queue_drops_on_quiesce() -> List[Finding]:
+    """A quiesce that clears the queue instead of parking it (the
+    classic shutdown/epoch-switch confusion): the parked op's handle
+    never resolves after resume + drain, and the state-machine check
+    on the REAL engine class must notice the loss."""
+    from bluefog_tpu.progress import ProgressEngine
+
+    class Droppy(ProgressEngine):
+        def quiesce(self, timeout: float = 60.0) -> int:
+            with self._cv:
+                self._q.clear()
+            return super().quiesce(timeout)
+
+    return progress_rules.check_schedule(
+        [("put", "w"), "quiesce", "resume", "step"],
+        subject="fixture[queue-drops-on-quiesce]", engine_cls=Droppy)
+
+
+def _progress_handle_double_complete() -> List[Finding]:
+    """A worker that resolves the same handle on the requeue path AND
+    the success path — the exactly-once lifecycle lint must flag the
+    second resolution."""
+    return progress_rules.check_handle_events(
+        [("h0", "create"), ("h0", "complete"), ("h0", "complete"),
+         ("h0", "result")],
+        subject="fixture[handle-double-complete]")
+
+
+def _progress_fusion_reorders() -> List[Finding]:
+    """A fuser that coalesced two same-window puts ACROSS an interleaved
+    other-window put: the combined deposit stream no longer replays in
+    submission order."""
+    subs = [(0, "put", "a", None, 8), (1, "put", "b", None, 8),
+            (2, "put", "a", None, 8)]
+    batches = [("put", "a", (0, 2)), ("put", "b", (1,))]
+    return progress_rules.check_batches(
+        subs, batches, budget=1 << 20,
+        subject="fixture[fusion-reorders]")
+
+
 FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
     # plan family
     "plan-duplicate-destination": _plan_duplicate_destination,
@@ -542,6 +588,10 @@ FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
     "trace-unbalanced-nesting": _trace_unbalanced_nesting,
     "trace-dangling-flow": _trace_dangling_flow,
     "trace-clock-skew": _trace_clock_skew,
+    # progress family: dropped queue, double resolution, reordered fuse
+    "progress-queue-drops-on-quiesce": _progress_queue_drops_on_quiesce,
+    "progress-handle-double-complete": _progress_handle_double_complete,
+    "progress-fusion-reorders": _progress_fusion_reorders,
     # epoch family: ill-ordered window traces
     "epoch-use-after-free": lambda: epoch_rules.check_trace(
         [("win_create", "w"), ("win_put", "w"), ("win_free", "w"),

@@ -91,7 +91,8 @@ def render(snap: dict, rates: Dict[int, float]) -> str:
                      f"pending_scale={la.get('pending_scale', 0)}")
     lines.append("")
     lines.append(f"{'RANK':>4} {'STEP':>8} {'STEP/S':>7} {'EPOCH':>5} "
-                 f"{'LAST OP':<12} {'BALANCE':>10} {'HOLDS':<8} EDGES")
+                 f"{'LAST OP':<12} {'BALANCE':>10} {'QUEUE':<14} "
+                 f"{'HOLDS':<8} EDGES")
     for r in ranks:
         page = snap["ranks"][str(r)]
         if "error" in page:
@@ -102,11 +103,19 @@ def render(snap: dict, rates: Dict[int, float]) -> str:
             f"{e['peer']}:{_EDGE_CHAR.get(e['state'], '?')}"
             for e in page["edges"])
         holds = ",".join(f"m{m}" for m in sorted(held_by.get(r, []))) or "-"
+        # the progress-engine view (statuspage v2): queue depth plus the
+        # op the worker is landing right now; "-" = no engine running
+        prog = page.get("progress", {})
+        qd = prog.get("qdepth", -1)
+        queue = "-" if qd < 0 else (
+            f"{qd}" + (f">{prog['inflight']}" if prog.get("inflight")
+                       else ""))
         lines.append(
             f"{r:>4} {page['step']:>8} "
             f"{('%.1f' % rate) if rate is not None else '—':>7} "
             f"{page['epoch']:>5} {page['last_op']:<12} "
-            f"{page['ledger']['balance']:>10.3g} {holds:<8} {edges}")
+            f"{page['ledger']['balance']:>10.3g} {queue:<14} "
+            f"{holds:<8} {edges}")
     if snap.get("suspects"):
         lines.append("")
         lines.append(f"straggler suspects: "

@@ -28,18 +28,21 @@ from typing import Dict, List, Optional, Tuple
 
 from bluefog_tpu.native import shm_native
 
-STATUS_SCHEMA = "bftpu-statuspage/1"
+STATUS_SCHEMA = "bftpu-statuspage/2"
 STATUS_MAGIC = 0x42465350  # "BFSP"
-STATUS_VERSION = 1
+STATUS_VERSION = 2
 
 #: Page layout: header (magic u32, version u32, seq u64), fixed block,
 #: then up to MAX_EDGES edge records; the whole page is padded to
 #: PAGE_BYTES so the file size is stable across republishes.
+#: v2 appends the progress-engine view (queue depth + in-flight op) to
+#: the fixed block; readers still decode v1 pages from live v1 writers.
 _HEAD = struct.Struct("<IIQ")                 # magic, version, seq
-_FIXED = struct.Struct("<iiiiQQQdd16sdddd")   # rank, nranks, pid, n_edges,
-#                                               step, epoch, op_id,
-#                                               wall_ts, mono_ts, last_op,
-#                                               ledger dep/col/drn/pend
+_FIXED_V1 = struct.Struct("<iiiiQQQdd16sdddd")  # rank, nranks, pid, n_edges,
+#                                                 step, epoch, op_id,
+#                                                 wall_ts, mono_ts, last_op,
+#                                                 ledger dep/col/drn/pend
+_FIXED = struct.Struct("<iiiiQQQdd16sddddi16s")  # ... + qdepth, inflight
 _EDGE = struct.Struct("<iid")                 # peer_global, state, deadline_s
 MAX_EDGES = 32
 PAGE_BYTES = 1024
@@ -79,12 +82,14 @@ class StatusPage:
 
     def publish(self, *, nranks: int, step: int, epoch: int, op_id: int,
                 last_op: str = "", ledger: Optional[Dict[str, float]] = None,
-                edges=()) -> None:
+                edges=(), qdepth: int = -1, inflight: str = "") -> None:
         """Seqlocked single-writer update of the whole page.
 
         ``edges`` is an iterable of ``(peer_global, state_code,
         deadline_s)`` tuples (truncated at MAX_EDGES); ``ledger`` maps
-        the ``_LEDGER_KEYS`` to mass totals (missing keys read 0.0)."""
+        the ``_LEDGER_KEYS`` to mass totals (missing keys read 0.0);
+        ``qdepth``/``inflight`` mirror the rank's progress engine
+        (-1 = no engine running)."""
         mm = self._seg._mm
         led = ledger or {}
         ed = list(edges)[:MAX_EDGES]
@@ -99,7 +104,9 @@ class StatusPage:
             time.time(), time.monotonic(),
             str(last_op).encode("utf-8", "replace")[:16],
             float(led.get("deposits", 0.0)), float(led.get("collected", 0.0)),
-            float(led.get("drained", 0.0)), float(led.get("pending", 0.0)))
+            float(led.get("drained", 0.0)), float(led.get("pending", 0.0)),
+            int(qdepth),
+            str(inflight).encode("utf-8", "replace")[:16])
         off = _HEAD.size + _FIXED.size
         for peer, state, deadline in ed:
             _EDGE.pack_into(mm, off, int(peer), int(state), float(deadline))
@@ -115,12 +122,22 @@ def _decode(buf: bytes) -> Dict[str, object]:
     magic, version, seq = _HEAD.unpack_from(buf, 0)
     if magic != STATUS_MAGIC:
         raise ValueError(f"not a status page (magic 0x{magic:08x})")
-    if version != STATUS_VERSION:
+    if version not in (1, STATUS_VERSION):
         raise ValueError(f"unsupported status-page version {version}")
-    (rank, nranks, pid, n_edges, step, epoch, op_id, wall_ts, mono_ts,
-     last_op, dep, col, drn, pend) = _FIXED.unpack_from(buf, _HEAD.size)
+    if version == 1:
+        # a live v1 writer (mid-upgrade fleet): no progress-engine block
+        (rank, nranks, pid, n_edges, step, epoch, op_id, wall_ts, mono_ts,
+         last_op, dep, col, drn, pend) = _FIXED_V1.unpack_from(
+            buf, _HEAD.size)
+        qdepth, inflight = -1, b""
+        fixed_size = _FIXED_V1.size
+    else:
+        (rank, nranks, pid, n_edges, step, epoch, op_id, wall_ts, mono_ts,
+         last_op, dep, col, drn, pend, qdepth, inflight) = \
+            _FIXED.unpack_from(buf, _HEAD.size)
+        fixed_size = _FIXED.size
     edges: List[Dict[str, object]] = []
-    off = _HEAD.size + _FIXED.size
+    off = _HEAD.size + fixed_size
     for _ in range(max(0, min(n_edges, MAX_EDGES))):
         peer, state, deadline = _EDGE.unpack_from(buf, off)
         off += _EDGE.size
@@ -146,6 +163,11 @@ def _decode(buf: bytes) -> Dict[str, object]:
             "deposits": dep, "collected": col,
             "drained": drn, "pending": pend,
             "balance": dep - col - drn,
+        },
+        "progress": {
+            "qdepth": int(qdepth),
+            "inflight": inflight.split(b"\0", 1)[0].decode(
+                "utf-8", "replace"),
         },
         "edges": edges,
     }

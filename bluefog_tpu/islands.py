@@ -43,6 +43,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 import networkx as nx
 import numpy as np
 
+from bluefog_tpu import progress as _progress
 from bluefog_tpu import topology_util
 from bluefog_tpu.native import shm_native
 from bluefog_tpu.resilience import adaptive as _adaptive
@@ -75,6 +76,10 @@ __all__ = [
     "win_accumulate",
     "win_get",
     "win_update",
+    "win_put_async",
+    "win_accumulate_async",
+    "win_update_async",
+    "progress_engine",
     "win_absorbed",
     "win_update_then_collect",
     "win_sync",
@@ -143,6 +148,13 @@ class _IslandWindow:
         # counts, write off deposits it will never combine)
         self._deposited_to: Dict[int, int] = {}
         self._seed_ver = 0 if zero_init else 1
+        # progress-engine prefetch state: per-slot persistent warm buffer
+        # + the slot version it holds.  The idle worker re-reads a slot
+        # (read-only, no collect — zero semantic/mass effect) only when
+        # its deposit count moved, leaving the mailbox pages cache-warm
+        # for the caller's next combine.
+        self._warm: Dict[int, np.ndarray] = {}
+        self._warm_ver: Dict[int, int] = {}
         self.shm = shm_native.make_window(
             ctx.job, name, ctx.rank, ctx.size, maxd,
             tensor.shape, tensor.dtype,
@@ -215,6 +227,10 @@ class _IslandContext:
         self.statuspage = None
         self.tracectl = None
         self.op_rounds = 0
+        # per-rank background progress engine (bluefog_tpu.progress),
+        # created lazily on the first *_async call so synchronous
+        # programs never pay for the worker thread
+        self.progress: Optional[_progress.ProgressEngine] = None
         if shm_native.statuspage_enabled():
             from bluefog_tpu.introspect import statuspage as _statuspage
 
@@ -322,6 +338,11 @@ def shutdown(unlink: bool = False) -> None:
     if _context is None:
         return
     ctx = _context
+    if ctx.progress is not None:
+        # the engine dies BEFORE the segments it deposits into: stop()
+        # drains the remaining queue through the still-open windows
+        ctx.progress.stop(drain=True)
+        ctx.progress = None
     ctx.detector.stop()
     reg = _telemetry.get_registry()
     for w in ctx.windows.values():
@@ -570,6 +591,14 @@ def _switch_epoch(ctx: "_IslandContext", rec: dict) -> None:
     reg = _telemetry.get_registry()
     tr = _tracing.get_tracer()
     t0 = time.perf_counter_ns()
+    if ctx.progress is not None:
+        # park the progress engine FIRST: the in-flight op completes into
+        # the old epoch's segments (its mass is then probed as pending or
+        # already committed below), and queued ops survive the rebind —
+        # they resolve their window by NAME at execution time, so after
+        # resume() they land in the new epoch's segments.  No op is lost
+        # or double-executed (the progress.queue-state-machine rule).
+        ctx.progress.quiesce()
     if rec.get("reweight"):
         # QUIESCE before probing: an adaptive reweight switches a fleet
         # where every member is alive and mid-gossip — a deposit landing
@@ -659,6 +688,8 @@ def _switch_epoch(ctx: "_IslandContext", rec: dict) -> None:
         win._seed_ver = 1
     ctx.shm_job.barrier()  # every (t, p) exposure restored — joiners
     ctx.shm_job.barrier()  # ... finished their onboarding reads
+    if ctx.progress is not None:
+        ctx.progress.resume()
     if tr.enabled:
         tr.instant("epoch_switch", aux=ctx.epoch)
     if reg.enabled:
@@ -1023,10 +1054,11 @@ def _check_dst(win: _IslandWindow, dst_weights: WeightDict):
 
 
 def _to_host(tensor) -> np.ndarray:
-    # jax.Array, torch.Tensor (cpu), or array-like → host numpy
-    if hasattr(tensor, "detach"):
-        tensor = tensor.detach().cpu().numpy()
-    return np.asarray(tensor)
+    # jax.Array, torch.Tensor (cpu), or array-like → host numpy.  On the
+    # progress-engine worker thread this is a zero-copy dlpack view when
+    # the producer allows; synchronous callers get the historical copy
+    # (progress/staging.py — the device→host staging-copy kill).
+    return _progress.staging.stage(tensor)
 
 
 class _IslandFusionMeta:
@@ -1149,6 +1181,26 @@ def win_free(name: Optional[str] = None) -> bool:
     names = [name] if name is not None else sorted(ctx.windows)
     ok = True
     reg = _telemetry.get_registry()
+    eng = ctx.progress
+    if eng is not None:
+        # flush queued async ops into the still-live segments, then park
+        # the worker: its idle prefetch must not touch a window whose
+        # mapping the loop below is about to close
+        for n in names:
+            eng.drain(window=n, timeout=60.0)
+        eng.quiesce()
+        for n in names:
+            eng.windows_seen.discard(n)
+    try:
+        ok = _win_free_inner(ctx, names, reg)
+    finally:
+        if eng is not None:
+            eng.resume()
+    return ok
+
+
+def _win_free_inner(ctx: "_IslandContext", names, reg) -> bool:
+    ok = True
     for n in names:
         w = ctx.windows.pop(n, None)
         if w is None:
@@ -1281,10 +1333,17 @@ def _statuspage_tick(ctx: "_IslandContext", name: str,
         edges.append((g, code, deadline))
     reg = _telemetry.get_registry()
     ledger = _ledger_totals(reg) if reg.enabled else None
+    eng = ctx.progress
+    qdepth, inflight = -1, ""
+    if eng is not None:
+        st = eng.stats()
+        qdepth = int(st["queue_depth"])
+        inflight = st["inflight"] or ""
     try:
         page.publish(nranks=len(ctx.members_global), step=ctx.op_rounds,
                      epoch=ctx.epoch, op_id=ctx.op_rounds,
-                     last_op=f"{op}:{name}", ledger=ledger, edges=edges)
+                     last_op=f"{op}:{name}", ledger=ledger, edges=edges,
+                     qdepth=qdepth, inflight=inflight)
     except (OSError, ValueError):
         pass  # a reaped segment must never fail the op itself
     if ctx.tracectl is not None:
@@ -1418,6 +1477,143 @@ def win_accumulate(tensor, name: str, dst_weights: WeightDict = None) -> bool:
             tr.end(ttok, emit=emits)
         _note_op("win_accumulate", name)
     return True
+
+
+class _ProgressBackend:
+    """Engine→transport adapter (the ``backend`` duck type in
+    :mod:`bluefog_tpu.progress.engine`).  Ops re-enter the PUBLIC
+    synchronous win ops, so telemetry, tracing, the mass ledger, and the
+    degraded-mode dead-rank filtering apply identically on the async
+    path; windows are resolved by NAME at execution time, which is what
+    makes queued ops survive a membership-epoch rebind."""
+
+    def execute(self, kind, window, payload, weights, kwargs):
+        if kind == "put":
+            return win_put(payload, window, dst_weights=weights)
+        if kind == "accumulate":
+            return win_accumulate(payload, window, dst_weights=weights)
+        return win_update(window, **kwargs)
+
+    def fuse(self, kind, window, payloads):
+        # put deposits overwrite the slot: executing only the LAST of a
+        # coalesced run is indistinguishable from executing all of them.
+        # accumulate deposits add: the run deposits its (packed) sum
+        # once — w·Σtᵢ == Σ(w·tᵢ), and the engine only fuses ops with
+        # identical weights.
+        if kind == "put":
+            return payloads[-1]
+        acc = np.array(_to_host(_island_pack(window, payloads[0])),
+                       copy=True)
+        for t in payloads[1:]:
+            acc += _to_host(_island_pack(window, t))
+        return acc
+
+    def epoch(self) -> int:
+        return _context.epoch if _context is not None else -1
+
+    def prefetch(self, names) -> int:
+        """Idle-time mailbox warming: one ``read_version`` word per
+        in-edge, and a read-only bracketed copy into a persistent warm
+        buffer for slots whose deposit count moved.  No collect, no
+        mass movement, no semantic effect — the caller's next combine
+        just runs over cache-warm pages."""
+        ctx = _context
+        if ctx is None:
+            return 0
+        n = 0
+        for name in names:
+            win = ctx.windows.get(name)
+            if win is None:
+                continue
+            pairs = [(win.slot_of[ctx.rank][s], s)
+                     for s in win.in_neighbors if s not in ctx.dead]
+            for slot, src, ver in shm_native.poll_versions(
+                    win.shm, pairs, win._warm_ver):
+                buf = win._warm.get(slot)
+                if (buf is None or buf.shape != win.shm.shape
+                        or buf.dtype != win.shm.dtype):
+                    buf = win._warm[slot] = np.empty(
+                        win.shm.shape, dtype=win.shm.dtype)
+                try:
+                    win.shm.read(slot, collect=False, src=src, out=buf)
+                except TypeError:  # transport without out= support
+                    win.shm.read(slot, collect=False, src=src)
+                win._warm_ver[slot] = ver
+                n += 1
+        return n
+
+
+def progress_engine() -> Optional[_progress.ProgressEngine]:
+    """This rank's background progress engine, creating it on first use.
+    None when the engine is disabled (``BFTPU_PROGRESS=0``) — the async
+    ops then run synchronously at the call site."""
+    ctx = _ctx()
+    if not _progress.enabled():
+        return None
+    eng = ctx.progress
+    if eng is None or eng.stopped:
+        eng = ctx.progress = _progress.ProgressEngine(
+            _ProgressBackend(), name=f"{ctx.base_job}:{ctx.global_rank}")
+    return eng
+
+
+def _payload_nbytes(win: _IslandWindow) -> int:
+    # deposits must match the window shape, so the fusion-budget estimate
+    # never needs to stage the (possibly still-computing) payload
+    return int(np.prod(win.shm.shape, dtype=np.int64)
+               * np.dtype(win.shm.dtype).itemsize)
+
+
+def win_put_async(tensor, name: str, dst_weights: WeightDict = None):
+    """:func:`win_put` off the critical path: enqueue the deposit on the
+    progress engine and return a
+    :class:`~bluefog_tpu.progress.handles.WinHandle` immediately — the
+    worker thread stages, fuses, and lands it while the caller's next
+    train step computes.  ``tensor`` may be a zero-arg callable (a
+    staging thunk materialized on the worker — where a blocking
+    device→host transfer belongs).  CONTRACT: do not donate/delete the
+    payload until the handle resolves."""
+    win = _win(name)  # surface unknown-window errors at the call site
+    eng = progress_engine()
+    if eng is None:
+        t = tensor() if callable(tensor) else tensor
+        return _progress.completed(win_put(t, name, dst_weights))
+    return eng.submit("put", name, payload=tensor, weights=dst_weights,
+                      nbytes=_payload_nbytes(win))
+
+
+def win_accumulate_async(tensor, name: str,
+                         dst_weights: WeightDict = None):
+    """:func:`win_accumulate` through the progress engine — see
+    :func:`win_put_async`.  Fused runs deposit their sum once; the mass
+    ledger balance is unchanged because accumulation is additive."""
+    win = _win(name)
+    eng = progress_engine()
+    if eng is None:
+        t = tensor() if callable(tensor) else tensor
+        return _progress.completed(win_accumulate(t, name, dst_weights))
+    return eng.submit("accumulate", name, payload=tensor,
+                      weights=dst_weights, nbytes=_payload_nbytes(win))
+
+
+def win_update_async(name: str, self_weight: Optional[float] = None,
+                     neighbor_weights: WeightDict = None,
+                     reset: bool = False):
+    """:func:`win_update` through the progress engine; the handle's
+    ``result()`` is the combined tensor (or pytree).  The combine runs
+    on the worker in submission order after any queued deposits to the
+    same window — the per-window FIFO the verifier family checks.  The
+    result is always an independent copy (``clone`` semantics): it must
+    stay valid while later queued ops keep mutating the window."""
+    _win(name)
+    eng = progress_engine()
+    if eng is None:
+        return _progress.completed(win_update(
+            name, self_weight=self_weight,
+            neighbor_weights=neighbor_weights, reset=reset, clone=True))
+    return eng.submit("update", name, self_weight=self_weight,
+                      neighbor_weights=neighbor_weights, reset=reset,
+                      clone=True)
 
 
 def win_get(name: str, src_weights: WeightDict = None) -> bool:
@@ -2018,8 +2214,9 @@ class DistributedWinPutOptimizer:
         self.overlap = bool(overlap)
         self._step_count = 0
         self._groups = None  # [(leaf_indices, shapes, sizes, np_dtype)]
-        self._executor = None  # 1-thread pool, created lazily (overlap mode)
-        self._pending = None   # Future[list of combined buffers per group]
+        # in-flight gossip round: [(put_handle, update_handle)] per group,
+        # resolved by the rank's progress engine (bluefog_tpu.progress)
+        self._pending = None
 
     def _pack(self, flat, idxs, dtype):
         return np.concatenate(
@@ -2067,26 +2264,27 @@ class DistributedWinPutOptimizer:
 
     # -- overlap machinery (round-3 verdict #5 / SURVEY §3.3: the
     # reference's background thread lands MPI_Put while the device keeps
-    # computing; here a 1-thread pool runs the whole host side of a gossip
-    # round — device→host staging, shm deposits, mailbox combine — while
-    # the caller's NEXT forward/backward executes on device) ------------
+    # computing; here the rank's progress engine runs the whole host side
+    # of a gossip round — device→host staging, shm deposits, mailbox
+    # combine — while the caller's NEXT forward/backward executes on
+    # device) ------------------------------------------------------------
 
-    def _gossip_round(self, leaf_refs):
-        """The background half of one gossip round.  ``leaf_refs`` are the
-        (possibly still-computing) device arrays; ``np.asarray`` inside
-        ``_pack`` blocks until the device produces them — in THIS thread,
-        so the main thread has already returned and dispatched more work.
-        Returns the combined buffer per group."""
-        # its own timeline span: with BLUEFOG_TIMELINE set, the trace
-        # shows these rounds overlapping the main thread's device steps —
-        # the visual form of the reference's background-thread overlap
-        with timeline_context("overlap_gossip_round"):
-            out = []
-            for g, (idxs, _, _, dt) in enumerate(self._groups):
-                name = f"{self.prefix}.{g}"
-                win_put(self._pack(leaf_refs, idxs, dt), name)
-                out.append(win_update(name))
-            return out
+    def _submit_gossip_round(self, leaf_refs):
+        """Enqueue one gossip round on the progress engine.  The put
+        payload is a THUNK over the (possibly still-computing) device
+        arrays: the engine worker materializes it, blocking on device
+        completion there — the main thread has already returned and
+        dispatched more work.  Returns [(put_handle, update_handle)] per
+        group; with the engine disabled the round runs inline and the
+        handles come back already resolved (same one-step-stale apply)."""
+        pairs = []
+        for g, (idxs, _, _, dt) in enumerate(self._groups):
+            name = f"{self.prefix}.{g}"
+            ph = win_put_async(
+                lambda idxs=idxs, dt=dt: self._pack(leaf_refs, idxs, dt),
+                name)
+            pairs.append((ph, win_update_async(name)))
+        return pairs
 
     def _apply_pending(self, params):
         """Wait for the in-flight gossip round (if any) and swap its
@@ -2096,17 +2294,36 @@ class DistributedWinPutOptimizer:
 
         if self._pending is None:
             return params
-        combineds = self._pending.result()
-        self._pending = None
+        pending, self._pending = self._pending, None
         flat, treedef = jax.tree_util.tree_flatten(params)
         for g, (idxs, shapes, sizes, _) in enumerate(self._groups):
-            self._unpack_into(flat, combineds[g], idxs, shapes, sizes)
+            put_h, upd_h = pending[g]
+            put_h.result()  # surface deposit failures, not just combine's
+            self._unpack_into(flat, upd_h.result(), idxs, shapes, sizes)
         return jax.tree_util.tree_unflatten(treedef, flat)
 
     def finish(self, params):
-        """Drain the overlap pipeline: apply any in-flight combine.  Call
-        after the training loop (before settle/evaluation/checkpoint)."""
-        return self._apply_pending(params)
+        """Drain the overlap pipeline: apply any in-flight combine, then
+        release the overlap machinery (``close``).  Call after the
+        training loop (before settle/evaluation/checkpoint)."""
+        params = self._apply_pending(params)
+        self.close()
+        return params
+
+    def close(self):
+        """Release the overlap machinery (idempotent): drain and discard
+        any in-flight round so repeated optimizer init/teardown leaks
+        neither threads nor queued ops.  The progress engine itself is
+        rank-global and stays up for other callers; historically this
+        optimizer owned a private ThreadPoolExecutor that ``finish``
+        never shut down — that leak is what this method retires."""
+        pending, self._pending = self._pending, None
+        for put_h, upd_h in pending or ():
+            for h in (put_h, upd_h):
+                try:
+                    h.result(timeout=60.0)
+                except Exception:  # noqa: BLE001 - draining, not applying
+                    pass
 
     def step(self, params, grads, state):
         import jax
@@ -2131,17 +2348,10 @@ class DistributedWinPutOptimizer:
                         optimizer="island_winput").inc()
         flat, treedef = jax.tree_util.tree_flatten(params)
         if self.overlap:
-            if self._executor is None:
-                import concurrent.futures
-
-                self._executor = concurrent.futures.ThreadPoolExecutor(
-                    max_workers=1,
-                    thread_name_prefix=f"{self.prefix}.gossip",
-                )
-            # hand the DEVICE refs to the background thread: it blocks on
-            # device completion there, then runs the shm round while the
-            # caller's next step computes
-            self._pending = self._executor.submit(self._gossip_round, flat)
+            # hand the DEVICE refs to the progress engine: its worker
+            # blocks on device completion, then lands the shm round while
+            # the caller's next step computes
+            self._pending = self._submit_gossip_round(flat)
             return params, state
         for g, (idxs, shapes, sizes, dt) in enumerate(self._groups):
             name = f"{self.prefix}.{g}"
@@ -2172,20 +2382,10 @@ class DistributedWinPutOptimizer:
 
     def free(self):
         """Collective: release the optimizer's windows (drains the overlap
-        thread first — a deposit must not race the teardown barrier)."""
-        if self._pending is not None:
-            try:
-                # drain only: the combine is discarded, and a failed round
-                # (e.g. a peer tore the window down) must not skip the
-                # collective win_free below — siblings would block forever
-                # in its barrier
-                self._pending.result()
-            except Exception:  # noqa: BLE001
-                pass
-            self._pending = None
-        if self._executor is not None:
-            self._executor.shutdown(wait=True)
-            self._executor = None
+        pipeline first — a deposit must not race the teardown barrier; a
+        failed round must not skip the collective win_free, or siblings
+        would block forever in its barrier)."""
+        self.close()
         for g in range(len(self._groups or [])):
             win_free(f"{self.prefix}.{g}")
 

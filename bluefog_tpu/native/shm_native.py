@@ -1434,6 +1434,24 @@ def unlink_segment(job: str, suffix: str) -> None:
     _unlink_name(seg_name(job, suffix))
 
 
+def poll_versions(win, pairs, seen):
+    """Slots whose deposit count moved: ``[(slot, src, version)]`` for
+    each ``(slot, src)`` in ``pairs`` whose ``read_version`` differs from
+    ``seen[slot]``.  One lock-free word read per pair — the progress
+    engine's idle prefetch uses this to re-read only edges with fresh
+    deposits.  Transports without version words (or a slot torn down
+    mid-poll) contribute nothing rather than raising."""
+    moved = []
+    for slot, src in pairs:
+        try:
+            ver = int(win.read_version(slot, src=src))
+        except Exception:  # noqa: BLE001 - polling must never raise
+            continue
+        if ver != seen.get(slot):
+            moved.append((slot, src, ver))
+    return moved
+
+
 # ---------------------------------------------------------------------------
 # membership-epoch word (elastic membership; resilience/join.py)
 # ---------------------------------------------------------------------------

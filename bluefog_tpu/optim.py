@@ -482,7 +482,20 @@ class DistributedWinPutOptimizer:
             adapted = jax.tree_util.tree_unflatten(treedef, flat)
         return adapted, state
 
+    def close(self):
+        """API parity with the island optimizer's ``close()``: the
+        emulation has no background pipeline to drain, so this is a
+        documented no-op — teardown code written against the island
+        surface (``finish``/``close``/``free``) runs unchanged here."""
+
+    def finish(self, params):
+        """Parity with the island optimizer: no overlap pipeline to
+        apply, so the params come back unchanged (after ``close``)."""
+        self.close()
+        return params
+
     def free(self):
+        self.close()
         if self._created:
             ctx = basics.context()
             for name in [n for n in ctx.windows if n.startswith(self.prefix + ".")]:

@@ -1,0 +1,79 @@
+"""Zero-copy device→host staging for the progress worker.
+
+Every island win op stages its payload to host numpy before touching
+the shm wire.  The historical spelling — ``np.asarray(tensor)`` — is a
+full device→host copy on accelerator backends, paid INSIDE the training
+step.  On the worker thread that copy is avoidable: a ``jax.Array``
+(or any dlpack exporter) can hand numpy a read-only view of its host
+buffer via ``np.from_dlpack``, and the shm deposit reads straight out
+of it — the staging copy the ROADMAP names simply disappears.  The
+``progress.staging_bytes_saved`` telemetry counter measures exactly the
+bytes that took the view path instead of a copy.
+
+The view path is gated to the engine worker thread (``worker_scope``):
+a view aliases the producing array's buffer, which is only safe under
+the engine's documented contract that callers must not donate/delete
+in-flight arrays (the same contract the overlap optimizer always had).
+Synchronous callers keep the copying behavior bit-for-bit.
+
+When the exporter refuses (non-CPU buffer and no host view, torch
+tensors requiring grad, older numpy without ``from_dlpack``) we fall
+back to the plain copy — staging never fails because zero-copy did.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import numpy as np
+
+from bluefog_tpu.telemetry import registry as _telemetry
+
+_tls = threading.local()
+
+
+def in_worker() -> bool:
+    """Whether the current thread is inside a progress-worker scope."""
+    return bool(getattr(_tls, "active", False))
+
+
+@contextlib.contextmanager
+def worker_scope():
+    """Mark the current thread as a progress worker: staging inside the
+    scope may return zero-copy dlpack views."""
+    prev = getattr(_tls, "active", False)
+    _tls.active = True
+    try:
+        yield
+    finally:
+        _tls.active = prev
+
+
+def _dlpack_view(tensor):
+    """Read-only host view of a dlpack exporter, or None."""
+    from_dlpack = getattr(np, "from_dlpack", None)
+    if from_dlpack is None or not hasattr(tensor, "__dlpack__"):
+        return None
+    try:
+        v = from_dlpack(tensor)
+    except Exception:  # noqa: BLE001 - any refusal means "copy instead"
+        return None
+    return v if isinstance(v, np.ndarray) else None
+
+
+def stage(tensor) -> np.ndarray:
+    """Host ndarray for ``tensor`` — a zero-copy view when staged on the
+    worker thread and the producer exports dlpack, a copy otherwise."""
+    if isinstance(tensor, np.ndarray):
+        return tensor
+    if in_worker():
+        v = _dlpack_view(tensor)
+        if v is not None:
+            reg = _telemetry.get_registry()
+            if reg.enabled:
+                reg.counter("progress.staging_bytes_saved").add(int(v.nbytes))
+            return v
+    if hasattr(tensor, "detach"):  # torch.Tensor (cpu)
+        tensor = tensor.detach().cpu().numpy()
+    return np.asarray(tensor)

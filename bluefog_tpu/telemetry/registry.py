@@ -204,6 +204,15 @@ class Gauge:
             if v > self.max:
                 self.max = v
 
+    def add(self, v):
+        """Signed delta on the last value (e.g. queue depth up/down from
+        two threads) — a read-modify-write ``set`` would race."""
+        v = float(v)
+        with self._lock:
+            self.value += v
+            if self.value > self.max:
+                self.max = self.value
+
     def to_dict(self) -> dict:
         return {"name": self.name, "labels": self.labels,
                 "value": self.value, "max": self.max}

@@ -296,6 +296,12 @@ class Tracer:
             span["emit"] = emit
         if consume:
             span["consume"] = consume
+        cur = threading.current_thread()
+        if cur is not threading.main_thread():
+            # off-main-thread spans (the progress-engine worker) carry
+            # their lane so the merged view separates background
+            # communication from the training step it overlaps
+            span["lane"] = cur.name
         self.spans.append(span)
 
     def instant(self, name: str, aux: int = 0) -> None:

@@ -60,6 +60,9 @@ __all__ = [
     "win_get_nonblocking",
     "win_accumulate",
     "win_accumulate_nonblocking",
+    "win_put_async",
+    "win_accumulate_async",
+    "win_update_async",
     "win_update",
     "win_put_update",
     "win_update_then_collect",
@@ -619,6 +622,39 @@ def win_accumulate_nonblocking(tensor, name: str, dst_weights: WeightsArg = None
 
     win_accumulate(tensor, name, dst_weights)
     return Handle(_completion_probe(_win(name).mail))
+
+
+def win_put_async(tensor, name: str, dst_weights: WeightsArg = None):
+    """API parity with :func:`bluefog_tpu.islands.win_put_async`: the
+    bulk-synchronous emulation has no background wire, so the op executes
+    at the call site and the returned
+    :class:`~bluefog_tpu.progress.handles.WinHandle` is already resolved
+    — programs written against the async surface run unchanged here."""
+    from bluefog_tpu import progress as _progress
+
+    t = tensor() if callable(tensor) else tensor
+    return _progress.completed(win_put(t, name, dst_weights))
+
+
+def win_accumulate_async(tensor, name: str, dst_weights: WeightsArg = None):
+    """See :func:`win_put_async` — completed-handle parity wrapper."""
+    from bluefog_tpu import progress as _progress
+
+    t = tensor() if callable(tensor) else tensor
+    return _progress.completed(win_accumulate(t, name, dst_weights))
+
+
+def win_update_async(name: str,
+                     self_weight=None,
+                     neighbor_weights: WeightsArg = None,
+                     reset: bool = False):
+    """See :func:`win_put_async`; the handle's ``result()`` is the
+    combined tensor (``clone`` semantics, matching the island engine)."""
+    from bluefog_tpu import progress as _progress
+
+    return _progress.completed(win_update(
+        name, self_weight=self_weight, neighbor_weights=neighbor_weights,
+        reset=reset, clone=True))
 
 
 def win_get(name: str, src_weights: WeightsArg = None) -> bool:

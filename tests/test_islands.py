@@ -566,9 +566,11 @@ def _worker_winput_opt_overlap(rank, size, steps):
         grads = {"w": params["w"] - c, "b": params["b"] * 0.0}
         params, state = opt.step(params, grads, state)
         # overlap contract: the round is (at least sometimes) still in
-        # flight when step() returns
+        # flight when step() returns (pending is the progress engine's
+        # [(put_handle, update_handle)] per window group)
         saw_inflight = saw_inflight or (
-            opt._pending is not None and not opt._pending.done()
+            opt._pending is not None and not all(
+                h.done() for pair in opt._pending for h in pair)
         )
         time.sleep(float(rng.random()) * 0.0005)
     params = opt.finish(params)

@@ -83,7 +83,11 @@ def test_ulysses_grad_matches_dense():
 
     def loss_spmd(q, k, v):
         out = ulysses_attention(q, k, v, NODES_AXIS, SIZE, causal=True)
-        return jax.lax.psum(jnp.sum(out**2), NODES_AXIS)
+        # the LOCAL partial sum, not a psum: under grad, psum transposes
+        # to another psum, which over-counts each shard's cotangent by
+        # the axis size — the global loss is only the sum of the shard
+        # partials, and grad-of-partial already yields the dense grads
+        return jnp.sum(out**2)
 
     g = jax.jit(
         jax.shard_map(
