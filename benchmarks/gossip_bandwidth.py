@@ -306,6 +306,194 @@ def measure_statuspage_overhead(nprocs: int = 2, mb: float = 4.0,
     }
 
 
+def _tcp_wire_worker(rank, size, mb, iters, warmup):
+    """Gossip loop over the TCP mailbox, returning the wire accounting
+    counters alongside the timing (the compression-ratio headline needs
+    tcp.raw_payload_bytes vs tcp.wire_payload_bytes per rank)."""
+    import numpy as np
+
+    from bluefog_tpu import islands
+    from bluefog_tpu.telemetry import registry as _telemetry
+
+    islands.set_topology(topology_util.RingGraph(size))
+    elems = max(int(mb * 1e6 / 4), 1)
+    x = np.ones((elems,), np.float32)
+    islands.win_create(x, "bw")
+    out_deg = len(islands.out_neighbor_ranks())
+    for _ in range(warmup):
+        islands.win_put(x, "bw")
+        islands.win_update("bw")
+    islands.barrier()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        islands.win_put(x, "bw")
+        islands.win_update("bw")
+    dt = time.perf_counter() - t0
+    islands.barrier()
+    islands.win_free("bw")
+    reg = _telemetry.get_registry()
+    raw = reg.counter("tcp.raw_payload_bytes").value if reg.enabled else 0
+    wire = reg.counter("tcp.wire_payload_bytes").value if reg.enabled else 0
+    return out_deg * elems * 4 * iters, dt, raw, wire
+
+
+def _tcp_frame_worker(rank, job_name, coord, mb, iters, warmup, chunked, q):
+    """One end of the transport-level framing bench: rank 0 streams
+    window deposits at rank 1's mailbox server and times the acked
+    (committed) writes.  No islands layer — this isolates the wire
+    framing itself, which is what ``BFTPU_TCP_CHUNKED`` changes."""
+    import os as _os
+
+    _os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _os.environ["BFTPU_TCP_CHUNKED"] = chunked
+    _os.environ.pop("BFTPU_WIRE_DTYPE", None)  # f32: framing, not compression
+    import numpy as np
+
+    from bluefog_tpu.native.tcp_transport import TcpShmJob, TcpShmWindow
+
+    elems = max(int(mb * 1e6 / 4), 1)
+    job = TcpShmJob(job_name, rank, 2, coord)
+    win = TcpShmWindow(job_name, "frame", rank, 2, 2, (elems,),
+                       np.float32, coord)
+    job.barrier()
+    if rank == 0:
+        x = np.ones((elems,), np.float32)
+        for _ in range(warmup):
+            win.write(1, 0, x)
+        job.barrier()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            win.write(1, 0, x)  # returns only once every chunk is acked
+        dt = time.perf_counter() - t0
+        job.barrier()
+        q.put((elems * 4 * iters, dt))
+    else:
+        job.barrier()
+        job.barrier()
+        a, _, _ = win.read(0, collect=True)
+        assert float(a[0]) == 1.0  # the stream really landed
+    job.barrier()
+    win.close()
+    job.close()
+
+
+def measure_tcp_chunked(nprocs: int = 2, mb: float = 4.0, iters: int = 40,
+                        warmup: int = 5, repeats: int = 3) -> dict:
+    """Chunked pipelined TCP framing vs the legacy one-frame-per-deposit
+    framing — the ``tcp_chunked_gbps`` headline.
+
+    Transport-level: one writer process streams ``win.write`` deposits
+    into one mailbox-server process over loopback TCP (like iperf for
+    the deposit protocol), interleaved best-of-``repeats`` arms toggling
+    ``BFTPU_TCP_CHUNKED``.  Both arms run at f32 (``BFTPU_WIRE_DTYPE``
+    unset: the framing comparison must not conflate compression) and
+    the end-to-end islands gossip numbers stay with
+    :func:`measure_islands`.  ``value`` is the chunked arm's GB/s.
+    """
+    import multiprocessing as _mp
+    import socket as _socket
+
+    ctx = _mp.get_context("spawn")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    saved_pp = os.environ.get("PYTHONPATH")
+    os.environ["PYTHONPATH"] = root + (
+        os.pathsep + saved_pp if saved_pp else "")
+
+    def one(chunked, tag):
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+        job_name = f"framebench_{os.getpid()}_{tag}"
+        q = ctx.Queue()
+        ps = [ctx.Process(target=_tcp_frame_worker,
+                          args=(r, job_name, coord, mb, iters, warmup,
+                                chunked, q))
+              for r in (0, 1)]
+        for p_ in ps:
+            p_.start()
+        nbytes, dt = q.get(timeout=600)
+        for p_ in ps:
+            p_.join(60)
+            if p_.exitcode != 0:
+                raise RuntimeError(
+                    f"frame bench rank exited {p_.exitcode}")
+        return nbytes / dt / 1e9
+
+    legacy = chunked = 0.0
+    try:
+        for r in range(repeats):
+            legacy = max(legacy, one("0", f"l{r}"))
+            chunked = max(chunked, one("1", f"c{r}"))
+    finally:
+        if saved_pp is None:
+            os.environ.pop("PYTHONPATH", None)
+        else:
+            os.environ["PYTHONPATH"] = saved_pp
+    return {
+        "metric": f"tcp chunked-framing deposit bandwidth (1 writer -> 1 "
+                  f"server, {mb:g} MB payload, best of {repeats})",
+        "value": round(chunked, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(chunked / legacy, 3) if legacy else 0.0,
+        "legacy_gbs": round(legacy, 3),
+        "speedup": round(chunked / legacy, 3) if legacy else 0.0,
+    }
+
+
+def measure_wire_compression(nprocs: int = 2, mb: float = 4.0,
+                             iters: int = 10, warmup: int = 2,
+                             wire_dtype: str = "bf16") -> dict:
+    """Wire bytes / raw payload bytes for quantized TCP gossip deltas —
+    the ``wire_compression_ratio`` headline.
+
+    One np=``nprocs`` TCP ring run at ``BFTPU_WIRE_DTYPE=<wire_dtype>``
+    with telemetry on; the ratio comes from the transport's own
+    accounting counters (``tcp.wire_payload_bytes`` includes per-chunk
+    frame headers, so framing overhead is charged against compression).
+    The acceptance gate at bf16 is <= 0.55.
+    """
+    import functools
+    import shutil
+    import tempfile
+
+    from bluefog_tpu import islands
+
+    saved = {k: os.environ.get(k) for k in
+             ("BLUEFOG_ISLAND_TRANSPORT", "BFTPU_TCP_CHUNKED",
+              "BFTPU_WIRE_DTYPE", "BFTPU_TELEMETRY")}
+    td = tempfile.mkdtemp(prefix="bftpu_wire_bench_")
+    os.environ["BLUEFOG_ISLAND_TRANSPORT"] = "tcp"
+    os.environ.pop("BFTPU_TCP_CHUNKED", None)
+    os.environ["BFTPU_WIRE_DTYPE"] = wire_dtype
+    os.environ["BFTPU_TELEMETRY"] = td
+    try:
+        res = islands.spawn(
+            functools.partial(_tcp_wire_worker, mb=mb, iters=iters,
+                              warmup=warmup),
+            nprocs, timeout=600.0,
+        )
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    raw = sum(r for _, _, r, _ in res)
+    wire = sum(w for _, _, _, w in res)
+    ratio = wire / raw if raw else 0.0
+    return {
+        "metric": f"tcp wire compression ratio ({wire_dtype}, {nprocs} "
+                  f"processes, {mb:g} MB payload, headers charged)",
+        "value": round(ratio, 4),
+        "unit": "wire/raw",
+        "raw_mb": round(raw / 1e6, 2),
+        "wire_mb": round(wire / 1e6, 2),
+        "contract_max": 0.55,
+    }
+
+
 def _probe_gbs(mb: float, iters: int, chunk: int = None,
                depth: int = None) -> float:
     """One pipelined self-edge configuration: write leg and drain leg of

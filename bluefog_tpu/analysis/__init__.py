@@ -35,6 +35,11 @@ consumers (CLI, pytest, CI):
   manual-mode engine (exactly-once handles, order-preserving fusion,
   nothing executes while parked), handle-lifecycle trace lint, and the
   fusion-batch contiguity/budget contract;
+- **wire** (:mod:`.wire_rules`) — the one wire protocol shared by both
+  carriers: ascending chunk-stream commit integrity, credit-window
+  liveness of the pipelined TCP framing, error-feedback residual
+  conservation across demotion, mid-stream writer death vs the
+  disconnect drain, and TCP/shm protocol-spec parity;
 - **introspect** (:mod:`.introspect_rules`) — the live introspection
   plane: status pages read back schema-exact, settled, and
   ledger-consistent; mutex holder words always name a live member and
@@ -72,6 +77,7 @@ from bluefog_tpu.analysis import (  # noqa: F401
     seqlock_model,
     telemetry_rules,
     trace_rules,
+    wire_rules,
 )
 
 __all__ = [

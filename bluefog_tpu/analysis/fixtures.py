@@ -36,6 +36,7 @@ from bluefog_tpu.analysis import (
     seqlock_model,
     telemetry_rules,
     trace_rules,
+    wire_rules,
 )
 from bluefog_tpu.analysis.engine import Finding
 
@@ -575,6 +576,20 @@ FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
     "dead-writer-early-commit": lambda: _model_fixture(
         seqlock_model.dead_writer_drain_model(deposits=2,
                                               commits_after_payload=False)),
+    # wire family: the one wire protocol with one ingredient dropped
+    "wire-reordered-chunk-stream": lambda: _model_fixture(
+        wire_rules.chunk_stream_model(nchunks=3, writer_in_order=False,
+                                      enforce_order=False)),
+    "wire-credit-window-deadlock": lambda: _model_fixture(
+        wire_rules.credit_window_model(nchunks=3, window=1,
+                                       ack_per_chunk=False)),
+    "wire-residual-dropped-on-demote": lambda: _model_fixture(
+        wire_rules.residual_feedback_model(rounds=3, drop_on_demote=True)),
+    "wire-commit-at-stream-open": lambda: _model_fixture(
+        wire_rules.stream_death_model(nchunks=2,
+                                      commits_after_payload=False)),
+    "wire-drain-strands-reader": lambda: _model_fixture(
+        wire_rules.stream_death_model(nchunks=2, drain_evenizes=False)),
     # telemetry family: broken snapshots, regressed counters, lost mass
     "telemetry-counter-regression": _telemetry_counter_regression,
     "telemetry-snapshot-bad-schema": _telemetry_snapshot_bad_schema,

@@ -175,6 +175,17 @@ class RoutedWindow:
         else:
             self.tcp.reset(slot)
 
+    def force_drain(self, slot: int, src: Optional[int] = None) -> None:
+        # heal-path dead-writer drain, routed like read(): the slot lives
+        # in the transport the (dead) writer used
+        if src is not None and self.shm is not None \
+                and self._same_host(self.rank, src):
+            drain = getattr(self.shm, "force_drain", None)
+            if drain is not None:
+                drain(slot, src=src)
+        else:
+            self.tcp.force_drain(slot, src=src)
+
     # -- exposed ------------------------------------------------------------
     def expose(self, array, p: float = 1.0) -> None:
         # publish to both transports so any reader uses its natural path

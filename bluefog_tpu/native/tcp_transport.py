@@ -28,6 +28,29 @@ created lazily).  ``trace`` is the u64 trace-context word
 (:func:`bluefog_tpu.tracing.pack_ctx`; 0 = tracing off) that lets the
 merge CLI draw a flow arrow from the depositing span on the writer to
 the collecting span on the owner.  No external dependencies.
+
+One wire protocol (the v2 chunk state machine, ported from shm)
+----------------------------------------------------------------
+
+Window deposits default to the CHUNKED framing (``BFTPU_TCP_CHUNKED``):
+the sender splits the payload into ``shm_native.chunk_bytes()``-sized
+chunks — the SAME geometry the shm mailbox uses — and streams one
+``_OP_CHUNK`` frame per chunk (header+payload in one scatter-gather
+``sendmsg``), pipelined under a credit window
+(``BFTPU_TCP_WINDOW_CHUNKS`` frames in flight before one ack is
+collected — windowed credit, not stop-and-wait), then seals the deposit
+with an ``_OP_COMMIT`` frame.  The server commits chunks in ascending
+order into the mail slot and advances the slot version and push-sum
+mass ONLY at the commit frame (``TCP_DEPOSIT_COMMITS_AFTER_PAYLOAD``) —
+so a writer that dies mid-stream committed exactly zero mass, and the
+disconnect handler's drain (``TCP_DEAD_WRITER_DRAIN_STEPS``) restores
+the slot to the logical-zero drained state readers expect, just like
+shm's dead-writer drain.  Chunk frames may carry bf16/int8-quantized
+values (``BFTPU_WIRE_DTYPE``; per-chunk wire code in ``mode``, scale in
+``p``, element offset in ``trace``) with an error-feedback residual
+held per edge on the sender — see :mod:`bluefog_tpu.native.wire_codec`.
+Both transports are model-checked from one shared protocol spec by
+:mod:`bluefog_tpu.analysis.wire_rules`.
 """
 
 from __future__ import annotations
@@ -42,6 +65,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from bluefog_tpu.common.logging_util import logger
+from bluefog_tpu.native import wire_codec
 from bluefog_tpu.resilience.detector import PeerTimeoutError
 from bluefog_tpu.telemetry import registry as _telemetry
 from bluefog_tpu.tracing import tracer as _tracing
@@ -60,6 +84,13 @@ _OP_LIVENESS = 10      # rank-0 only: age of rank `slot`'s lease (in p)
 _OP_CLOCK = 11         # rank-0 only: coordinator's monotonic clock (in p)
 _OP_JOIN_RANK = 12     # rank-0 only: grant a fresh global rank (in slot)
 _OP_EPOCH = 13         # rank-0 only: membership-epoch word (read/publish)
+_OP_CHUNK = 14         # one chunk of a streaming deposit: mode packs
+                       # (chunk_idx << 8) | (wire_code << 1) | accumulate,
+                       # p carries the per-chunk quantization scale and
+                       # trace the element offset; acked per frame (credit)
+_OP_COMMIT = 15        # seal a chunk stream: mode packs (nchunks << 1) |
+                       # accumulate, p the EXACT push-sum mass, trace the
+                       # trace-context word; version/mass advance HERE
 
 #: human-readable op names: PeerTimeoutError context + telemetry labels
 _OP_NAMES = {
@@ -69,11 +100,26 @@ _OP_NAMES = {
     _OP_BARRIER_T: "barrier_timed", _OP_HEARTBEAT: "heartbeat",
     _OP_LIVENESS: "liveness", _OP_CLOCK: "clock",
     _OP_JOIN_RANK: "join_rank", _OP_EPOCH: "epoch",
+    _OP_CHUNK: "chunk", _OP_COMMIT: "commit",
 }
 
 # op, win_id, slot, mode, nbytes, p, trace — the trace word is LAST so
 # pre-trace header fields keep their offsets on the wire
 _HDR = struct.Struct("<iiiiqdQ")
+
+# -- protocol spec constants ---------------------------------------------
+# Model-checked against shm_native's spec by bluefog_tpu.analysis.
+# wire_rules: ONE wire protocol, two carriers.
+TCP_CHUNK_COMMIT_IN_ORDER = True
+TCP_DEPOSIT_COMMITS_AFTER_PAYLOAD = True
+TCP_DRAINED_COLLECT_IS_ATOMIC = True
+#: the disconnect-handler drain for a writer that died mid-stream, in
+#: order: make the slot seq even so readers stop spinning, mark it
+#: logically drained (reads as zeros, mass 0), then clear the stream
+#: registration — mark_drained MUST precede the clear, same invariant
+#: as shm's DEAD_WRITER_DRAIN_STEPS
+TCP_DEAD_WRITER_DRAIN_STEPS = ("evenize_wseq", "mark_drained",
+                               "clear_stream")
 
 
 def peer_timeout_s() -> Optional[float]:
@@ -87,6 +133,50 @@ def peer_timeout_s() -> Optional[float]:
     except ValueError:
         t = 120.0
     return t if t > 0 else None
+
+
+def tcp_chunked() -> bool:
+    """Chunked pipelined framing for window deposits
+    (``BFTPU_TCP_CHUNKED``; default on, ``0`` restores the legacy
+    whole-payload acked write — kept for A/B benches)."""
+    return os.environ.get("BFTPU_TCP_CHUNKED", "1") != "0"
+
+
+def window_chunks() -> int:
+    """Sender credit window: chunk frames in flight before one ack is
+    collected (``BFTPU_TCP_WINDOW_CHUNKS``, default 32; 1 degenerates
+    to stop-and-wait)."""
+    try:
+        w = int(os.environ.get("BFTPU_TCP_WINDOW_CHUNKS", "32"))
+    except ValueError:
+        w = 32
+    return max(w, 1)
+
+
+def _chunk_bytes() -> int:
+    # ONE chunk geometry for both transports: the shm setting
+    # (BLUEFOG_SHM_CHUNK_BYTES) governs the TCP stream too (lazy import:
+    # shm_native imports this module for transport selection)
+    from bluefog_tpu.native import shm_native
+    return shm_native.chunk_bytes()
+
+
+def _chunk_kill_after(src_rank: int) -> int:
+    """Chaos hook: ``BFTPU_CHAOS_KILL_CHUNK="<rank>:<n>"`` makes rank
+    ``<rank>`` (-1 = any) SIGKILL itself after streaming ``<n>`` chunk
+    frames of a deposit — the deterministic mid-stream death the
+    drain-path tests need (an external signal cannot time it).  Returns
+    -1 when no schedule matches."""
+    spec = os.environ.get("BFTPU_CHAOS_KILL_CHUNK")
+    if not spec:
+        return -1
+    try:
+        kr, kn = spec.split(":")
+        if int(kr) in (src_rank, -1):
+            return int(kn)
+    except ValueError:
+        pass
+    return -1
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -103,14 +193,75 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return buf  # bytearray: frombuffer/slice-assign/decode all accept it
 
 
-def _send_msg(sock, op, win_id=0, slot=0, mode=0, p=0.0, payload=b"",
-              trace=0):
-    hdr = _HDR.pack(op, win_id, slot, mode, len(payload), p, trace)
+class _BufReader:
+    """Buffered frame reader for a server connection: one ``recv_into``
+    syscall fetches MANY queued 40-byte chunk headers and acks at once
+    (the pipelined framing makes back-to-back small frames the common
+    case, and per-frame ``recv`` syscalls were the dominant per-chunk
+    cost).  Large payloads bypass the buffer — and ``read_into`` lands
+    them straight in caller memory (the mail slot), eliminating the
+    per-deposit staging allocation + copy of the legacy path."""
+
+    __slots__ = ("sock", "_buf", "_lo", "_hi")
+
+    def __init__(self, sock: socket.socket, bufsize: int = 1 << 16):
+        self.sock = sock
+        self._buf = memoryview(bytearray(bufsize))
+        self._lo = 0
+        self._hi = 0
+
+    def read_exact(self, n: int):
+        """n bytes as a bytes-like; small reads are served from the
+        buffer, which refills with bulk ``recv_into`` calls that sweep
+        up every queued frame the kernel already holds."""
+        avail = self._hi - self._lo
+        if avail < n <= len(self._buf):
+            if avail:  # compact the tail to the front before refilling
+                self._buf[:avail] = self._buf[self._lo:self._hi]
+            self._lo, self._hi = 0, avail
+            while self._hi < n:
+                r = self.sock.recv_into(self._buf[self._hi:],
+                                        len(self._buf) - self._hi)
+                if r == 0:
+                    raise ConnectionError("peer closed")
+                self._hi += r
+            avail = self._hi
+        if avail >= n:
+            out = bytes(self._buf[self._lo:self._lo + n])
+            self._lo += n
+            return out
+        out = bytearray(n)
+        self.read_into(memoryview(out))
+        return out
+
+    def read_into(self, dest) -> None:
+        """Fill ``dest`` (a writable memoryview) — buffered remainder
+        first, then straight ``recv_into`` the destination: payload
+        bytes cross exactly once from kernel to their final resting
+        place."""
+        n = len(dest)
+        avail = self._hi - self._lo
+        take = min(avail, n)
+        if take:
+            dest[:take] = self._buf[self._lo:self._lo + take]
+            self._lo += take
+        got = take
+        while got < n:
+            r = self.sock.recv_into(dest[got:], n - got)
+            if r == 0:
+                raise ConnectionError("peer closed")
+            got += r
+
+
+def _send_frame(sock, hdr, payload=b""):
+    """One frame in (at most) one syscall: scatter-gather ``sendmsg``
+    coalesces header+payload — no concat copy, no back-to-back
+    ``sendall`` pair; partial sends finish with zero-copy memoryview
+    slices.  Header-only frames (control ops, acks) ship as a single
+    ``sendall``."""
     if not payload:
         sock.sendall(hdr)
         return
-    # scatter-gather: no header+payload concat copy; finish partial sends
-    # with zero-copy memoryview slices
     sent = sock.sendmsg([hdr, memoryview(payload)])
     hl = len(hdr)
     if sent < hl:
@@ -118,6 +269,44 @@ def _send_msg(sock, op, win_id=0, slot=0, mode=0, p=0.0, payload=b"",
         sent = hl
     if sent < hl + len(payload):
         sock.sendall(memoryview(payload)[sent - hl:])
+
+
+def _send_iov(sock, bufs):
+    """MANY frames in one scatter-gather syscall: the pipelined chunk
+    stream pays one ``sendmsg`` per credit half-window instead of one
+    per chunk.  Partial sends resume with zero-copy memoryview slices."""
+    total = sum(len(b) for b in bufs)
+    sent = sock.sendmsg(bufs)
+    while sent < total:
+        i = 0
+        while sent >= len(bufs[i]):
+            sent -= len(bufs[i])
+            i += 1
+        bufs = [memoryview(bufs[i])[sent:]] + list(bufs[i + 1:])
+        total = sum(len(b) for b in bufs)
+        sent = sock.sendmsg(bufs)
+
+
+def _drain_acks(sock, k):
+    """Collect ``k`` header-only acks in bulk ``recv`` calls (the server
+    writes them back-to-back, so one syscall typically sweeps them all).
+    A server-side protocol error closes the connection, which surfaces
+    here as ConnectionError."""
+    if k > 0:
+        _recv_exact(sock, _HDR.size * k)
+
+
+def _send_msg(sock, op, win_id=0, slot=0, mode=0, p=0.0, payload=b"",
+              trace=0):
+    _send_frame(
+        sock, _HDR.pack(op, win_id, slot, mode, len(payload), p, trace),
+        payload,
+    )
+
+
+# the per-chunk credit ack, precomputed once: the hottest server->client
+# frame, sent once per chunk of every deposit
+_ACK_CHUNK = _HDR.pack(_OP_CHUNK, 0, 0, 0, 0, 0.0, 0)
 
 
 def _recv_msg(sock):
@@ -130,13 +319,20 @@ def _recv_msg(sock):
 
 
 class _Slot:
-    __slots__ = ("data", "p", "version", "trace")
+    __slots__ = ("data", "p", "version", "trace", "wseq", "drained")
 
     def __init__(self, nbytes: int):
         self.data = bytearray(nbytes)
         self.p = 0.0
         self.version = 0
         self.trace = 0  # trace-context word of the last deposit
+        # chunk-stream seq: even = settled, odd = a deposit is streaming
+        # into the slot (readers wait on the server's store_cond)
+        self.wseq = 0
+        # drained marker, the shm v2 trick: drained == version means the
+        # slot is LOGICALLY zero (mass 0) without touching the payload
+        # bytes — collect is one comparison + two stores, O(1)
+        self.drained = 0
 
 
 class _WinStore:
@@ -160,6 +356,14 @@ class _Server:
         self.nranks = nranks
         self.lock = threading.Lock()
         self.windows: Dict[int, _WinStore] = {}
+        # chunk-stream completion/drain notifications for readers of a
+        # mid-stream slot (wraps the SAME lock as the store)
+        self.store_cond = threading.Condition(self.lock)
+        # open chunk streams: (win_id, slot) -> state.  Exactly one
+        # writer owns a mailbox slot by construction, so the key needs
+        # no writer component; the owning connection is recorded so a
+        # disconnect can drain exactly its own torn streams.
+        self.streams: Dict[Tuple[int, int], dict] = {}
         # mutex (this rank's): the CONNECTION holding it, or None — owner
         # tracking lets a dead holder's disconnect release the lock
         self.mutex_cond = threading.Condition()
@@ -207,11 +411,162 @@ class _Server:
                 target=self._serve_conn, args=(conn,), daemon=True
             ).start()
 
+    def _handle_chunk(self, conn, rd, win_id, slot, mode, p, nbytes,
+                      trace):
+        """One ``_OP_CHUNK`` frame: open the stream on chunk 0, commit
+        the chunk into the mail slot in ascending order
+        (``TCP_CHUNK_COMMIT_IN_ORDER``), ack it (the sender's credit).
+        Any protocol violation drops the connection — the writer sees
+        ConnectionError instead of a corrupted slot.
+
+        Validation is header-driven so a RAW put chunk can be received
+        STRAIGHT into the mail slot (``rd.read_into``) — payload bytes
+        cross kernel→slot exactly once, with no staging buffer.  The
+        reception happens outside the server lock: the stream state
+        machine already serializes the slot (one writer per slot by
+        construction) and readers wait out the odd ``wseq``."""
+        idx = mode >> 8
+        code = (mode >> 1) & 0x3
+        acc = mode & 1
+        with self.lock:
+            w = self.windows[win_id]
+            s = w.mail[slot]
+            key = (win_id, slot)
+            st = self.streams.get(key)
+            if st is None:
+                if idx != 0:
+                    logger.error(
+                        "rank %d mailbox: chunk stream %d[%d] opened at "
+                        "chunk %d — dropping connection",
+                        self.rank, win_id, slot, idx,
+                    )
+                    raise ConnectionError("chunk stream opened mid-sequence")
+                fresh = s.drained == s.version
+                if acc and fresh:
+                    # accumulating onto a LOGICALLY zero slot: the bytes
+                    # may still hold the drained deposit — swap in a
+                    # zeroed buffer (calloc; no memset of the old one)
+                    s.data = bytearray(w.nbytes)
+                st = self.streams[key] = {
+                    "conn": conn, "next": 0, "acc": acc,
+                    "fresh": fresh, "elems": 0,
+                }
+                s.wseq += 1  # odd: a deposit is streaming into the slot
+            if st["conn"] is not conn or st["next"] != idx \
+                    or st["acc"] != acc:
+                logger.error(
+                    "rank %d mailbox: chunk %d to %d[%d] violates stream "
+                    "order (expected %d) — dropping connection",
+                    self.rank, idx, win_id, slot, st["next"],
+                )
+                raise ConnectionError("out-of-order chunk commit")
+            item = w.dtype.itemsize
+            if code == wire_codec.WIRE_RAW:
+                cnt = nbytes // item
+                endbyte = int(trace) * item + nbytes
+            elif code == wire_codec.WIRE_BF16:
+                cnt = nbytes // 2
+                endbyte = (int(trace) + cnt) * item
+            else:
+                cnt = nbytes
+                endbyte = (int(trace) + cnt) * item
+            off = int(trace)  # element offset rides the trace field
+            if endbyte > w.nbytes:
+                raise ConnectionError("chunk overruns window")
+            st["next"] = idx + 1
+            st["elems"] += cnt
+            do_acc = acc and not st["fresh"]
+            dest = (memoryview(s.data)[off * item:off * item + nbytes]
+                    if code == wire_codec.WIRE_RAW and not do_acc else None)
+        if dest is not None:
+            rd.read_into(dest)  # zero-copy commit: kernel -> slot
+        else:
+            payload = rd.read_exact(nbytes)
+            decoded = wire_codec.decode_chunk(payload, code, p, w.dtype,
+                                              cnt)
+            with self.lock:
+                region = np.frombuffer(s.data, w.dtype, count=cnt,
+                                       offset=off * item)
+                if do_acc:
+                    region += decoded
+                else:
+                    region[:] = decoded
+        conn.sendall(_ACK_CHUNK)
+
+    def _handle_commit(self, conn, win_id, slot, mode, p, trace):
+        """The ``_OP_COMMIT`` frame: version and push-sum mass advance
+        ONLY here, after every chunk landed
+        (``TCP_DEPOSIT_COMMITS_AFTER_PAYLOAD``) — a writer that dies
+        mid-stream committed zero mass, which is what makes the
+        disconnect drain sound."""
+        nchunks = mode >> 1
+        acc = mode & 1
+        with self.lock:
+            w = self.windows[win_id]
+            s = w.mail[slot]
+            st = self.streams.pop((win_id, slot), None)
+            if st is None or st["conn"] is not conn \
+                    or st["next"] != nchunks \
+                    or st["elems"] * w.dtype.itemsize != w.nbytes:
+                logger.error(
+                    "rank %d mailbox: commit of %d[%d] without a complete "
+                    "stream (%s) — dropping connection",
+                    self.rank, win_id, slot,
+                    "no stream" if st is None else
+                    f"{st['next']}/{nchunks} chunks, {st['elems']} elems",
+                )
+                raise ConnectionError("commit without a complete stream")
+            if acc and not st["fresh"]:
+                s.p += p
+            else:
+                s.p = p
+            s.version += 1
+            s.wseq += 1  # even again: the deposit is settled
+            if trace:
+                s.trace = trace
+            self.store_cond.notify_all()
+        _send_msg(conn, _OP_COMMIT)
+
+    def _drain_conn_streams(self, conn):
+        """Disconnect drain (``TCP_DEAD_WRITER_DRAIN_STEPS``): any slot
+        the dying connection left mid-stream is restored to the
+        logical-zero drained state — evenize the seq so readers stop
+        waiting, mark drained, clear the stream registration.  The torn
+        deposit committed zero mass (version unchanged), so heal-time
+        ledger accounting sees it as drained pending."""
+        reg = _telemetry.get_registry()
+        with self.lock:
+            for key, st in list(self.streams.items()):
+                if st["conn"] is not conn:
+                    continue
+                w = self.windows.get(key[0])
+                if w is not None:
+                    s = w.mail[key[1]]
+                    s.wseq += 1            # 1. evenize_wseq
+                    s.drained = s.version  # 2. mark_drained (reads zeros)
+                    s.p = 0.0
+                del self.streams[key]      # 3. clear_stream
+                self.store_cond.notify_all()
+                if reg.enabled:
+                    reg.counter("tcp.mid_stream_drains").inc()
+                    reg.journal("tcp_mid_stream_drain", win_id=key[0],
+                                slot=key[1], rank=self.rank)
+
     def _serve_conn(self, conn):
+        rd = _BufReader(conn)
         try:
             while True:
-                op, win_id, slot, mode, p, payload, trace = _recv_msg(conn)
-                if op == _OP_WRITE:
+                op, win_id, slot, mode, nbytes, p, trace = _HDR.unpack(
+                    rd.read_exact(_HDR.size))
+                if op == _OP_CHUNK:
+                    # payload handled inside (zero-copy into the slot)
+                    self._handle_chunk(conn, rd, win_id, slot, mode, p,
+                                       nbytes, trace)
+                    continue
+                payload = rd.read_exact(nbytes) if nbytes else b""
+                if op == _OP_COMMIT:
+                    self._handle_commit(conn, win_id, slot, mode, p, trace)
+                elif op == _OP_WRITE:
                     with self.lock:
                         w = self.windows[win_id]
                         s = w.mail[slot]
@@ -227,12 +582,15 @@ class _Server:
                                 len(payload), w.nbytes,
                             )
                             raise ConnectionError("size mismatch")
-                        if mode == 1 and w.dtype.kind == "f":
+                        if mode == 1 and w.dtype.kind == "f" \
+                                and s.drained != s.version:
                             a = np.frombuffer(bytes(s.data), w.dtype) + \
                                 np.frombuffer(payload, w.dtype)
                             s.data[:] = a.tobytes()
                             s.p += p
                         else:
+                            # put — or accumulate onto a logically-zero
+                            # (drained) slot, which is just a put
                             s.data[:] = payload
                             s.p = p
                         s.version += 1
@@ -349,6 +707,8 @@ class _Server:
                 if self.mutex_owner is conn:
                     self.mutex_owner = None
                     self.mutex_cond.notify()
+            # ... nor its slot torn: drain any stream it left mid-flight
+            self._drain_conn_streams(conn)
             conn.close()
 
     def stop(self):
@@ -377,6 +737,48 @@ class _Peers:
         self.conns: Dict[int, socket.socket] = {}
         self.locks: Dict[int, threading.Lock] = {}
 
+    def _connect(self, rank: int) -> socket.socket:
+        """Get-or-create the persistent connection (caller holds the
+        per-peer lock)."""
+        conn = self.conns.get(rank)
+        if conn is None:
+            host, port = self.table[rank].rsplit(":", 1)
+            conn = socket.create_connection((host, int(port)), timeout=60)
+            # a bounded deadline replaces the old unbounded wait: a
+            # request to a DEAD peer must eventually surface as a
+            # PeerTimeoutError naming the rank, not a silent hang
+            conn.settimeout(peer_timeout_s())
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self.conns[rank] = conn
+        return conn
+
+    def _evict(self, rank: int, conn) -> None:
+        # a half-done exchange leaves the stream unusable (a late reply
+        # would be mis-paired with the next request) — drop the socket so
+        # the NEXT request reconnects instead of failing forever
+        self.conns.pop(rank, None)
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _timeout_error(self, rank: int, opname: str) -> PeerTimeoutError:
+        reg = _telemetry.get_registry()
+        addr = self.table.get(rank)
+        if reg.enabled:
+            reg.counter("tcp.timeouts", op=opname).inc()
+            reg.journal("peer_timeout", peer_rank=rank, addr=addr,
+                        op=opname, deadline_s=peer_timeout_s())
+        tr = _tracing.get_tracer()
+        if tr.enabled:
+            tr.instant(f"peer_timeout:{opname}", aux=rank)
+            tr.dump_flight(f"PeerTimeoutError:{opname}:r{rank}")
+        return PeerTimeoutError(
+            f"rank {rank} ({addr}) did not respond to op "
+            f"{opname} within {peer_timeout_s()}s (set "
+            f"BFTPU_PEER_TIMEOUT_S to adjust)",
+            rank=rank, addr=addr, op=opname)
+
     def request(self, rank: int, op, win_id=0, slot=0, mode=0, p=0.0,
                 payload=b"", trace=0):
         reg = _telemetry.get_registry()
@@ -384,50 +786,16 @@ class _Peers:
         t0 = time.perf_counter_ns() if reg.enabled else 0
         lock = self.locks.setdefault(rank, threading.Lock())
         with lock:
-            conn = self.conns.get(rank)
-            if conn is None:
-                host, port = self.table[rank].rsplit(":", 1)
-                conn = socket.create_connection((host, int(port)), timeout=60)
-                # a bounded deadline replaces the old unbounded wait: a
-                # request to a DEAD peer must eventually surface as a
-                # PeerTimeoutError naming the rank, not a silent hang
-                conn.settimeout(peer_timeout_s())
-                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self.conns[rank] = conn
+            conn = self._connect(rank)
             try:
                 _send_msg(conn, op, win_id, slot, mode, p, payload,
                           trace=trace)
                 reply = _recv_msg(conn)
             except socket.timeout as e:
-                # half-done exchange: the stream is unusable (a late reply
-                # would be mis-paired with the next request) — evict it
-                self.conns.pop(rank, None)
-                try:
-                    conn.close()
-                except OSError:
-                    pass
-                addr = self.table.get(rank)
-                if reg.enabled:
-                    reg.counter("tcp.timeouts", op=opname).inc()
-                    reg.journal("peer_timeout", peer_rank=rank, addr=addr,
-                                op=opname, deadline_s=peer_timeout_s())
-                tr = _tracing.get_tracer()
-                if tr.enabled:
-                    tr.instant(f"peer_timeout:{opname}", aux=rank)
-                    tr.dump_flight(f"PeerTimeoutError:{opname}:r{rank}")
-                raise PeerTimeoutError(
-                    f"rank {rank} ({addr}) did not respond to op "
-                    f"{opname} within {peer_timeout_s()}s (set "
-                    f"BFTPU_PEER_TIMEOUT_S to adjust)",
-                    rank=rank, addr=addr, op=opname) from e
+                self._evict(rank, conn)
+                raise self._timeout_error(rank, opname) from e
             except (ConnectionError, OSError):
-                # evict the dead socket so the NEXT request reconnects
-                # instead of failing forever on a cached corpse
-                self.conns.pop(rank, None)
-                try:
-                    conn.close()
-                except OSError:
-                    pass
+                self._evict(rank, conn)
                 raise
         if reg.enabled:
             reg.counter("tcp.round_trips", op=opname).inc()
@@ -437,6 +805,111 @@ class _Peers:
             reg.histogram("tcp.rtt_s", op=opname).observe(
                 (time.perf_counter_ns() - t0) / 1e9)
         return reply
+
+    def deposit_chunked(self, rank: int, win_id: int, slot: int,
+                        arr: np.ndarray, p: float, accumulate: bool,
+                        trace: int, residual: Optional[np.ndarray] = None,
+                        src_rank: int = -1) -> None:
+        """Stream ONE window deposit as pipelined chunk frames + a commit.
+
+        The sender runs ahead of the acks under a credit window
+        (``BFTPU_TCP_WINDOW_CHUNKS``): it collects one ack per chunk
+        frame only once that many are outstanding, then sends the
+        ``_OP_COMMIT`` frame carrying the exact mass ``p`` and drains
+        the remaining credits — the whole deposit costs ~one RTT
+        instead of one per payload byte window.
+
+        ``residual`` (same dtype/size as ``arr``, flattened) enables
+        error-feedback quantization: the carry is folded into the
+        outgoing values and re-settled per chunk against what the wire
+        actually delivered, so ``sum(delivered) + residual`` always
+        equals ``sum(inputs)`` — mass conservation at the value level.
+        """
+        reg = _telemetry.get_registry()
+        t0 = time.perf_counter_ns() if reg.enabled else 0
+        code = wire_codec.wire_code() if arr.dtype.kind == "f" \
+            else wire_codec.WIRE_RAW
+        buf = arr.ravel() if residual is None else arr.ravel() + residual
+        elems = max(_chunk_bytes() // arr.dtype.itemsize, 1)
+        total = buf.size
+        nchunks = (total + elems - 1) // elems
+        credit = window_chunks()
+        acc = 1 if accumulate else 0
+        kill_after = _chunk_kill_after(src_rank)
+        wire_bytes = 0
+        lock = self.locks.setdefault(rank, threading.Lock())
+        with lock:
+            conn = self._connect(rank)
+            try:
+                # frames coalesce into half-credit-window sendmsg iovecs
+                # (one syscall apiece), acks drain in matching bulk
+                # recvs; the chaos kill path flushes per frame so the
+                # "die after n chunk frames" schedule stays exact
+                batch = max(credit // 2, 1) if kill_after < 0 else 1
+                outstanding = 0
+                pend = 0
+                iov = []
+                for idx in range(nchunks):
+                    lo = idx * elems
+                    hi = min(lo + elems, total)
+                    view = buf[lo:hi]
+                    code_i, payload, scale = wire_codec.encode_chunk(
+                        view, code)
+                    iov.append(_HDR.pack(
+                        _OP_CHUNK, win_id, slot,
+                        (idx << 8) | (code_i << 1) | acc,
+                        len(payload), scale, lo))
+                    if payload:
+                        iov.append(payload)
+                    pend += 1
+                    wire_bytes += _HDR.size + len(payload)
+                    if residual is not None:
+                        if code_i == wire_codec.WIRE_RAW:
+                            residual[lo:hi] = 0  # wire was exact
+                        else:
+                            residual[lo:hi] = view - wire_codec.decode_chunk(
+                                payload, code_i, scale, arr.dtype, hi - lo)
+                    if pend >= batch:
+                        over = outstanding + pend - credit
+                        if over > 0:  # honor the credit window FIRST
+                            _drain_acks(conn, over)
+                            outstanding -= over
+                        _send_iov(conn, iov)
+                        iov = []
+                        outstanding += pend
+                        pend = 0
+                    if kill_after >= 0 and idx + 1 >= kill_after:
+                        from bluefog_tpu.resilience.chaos import kill_self
+                        kill_self()
+                if pend:
+                    over = outstanding + pend - credit
+                    if over > 0:
+                        _drain_acks(conn, over)
+                        outstanding -= over
+                    _send_iov(conn, iov)
+                    outstanding += pend
+                _send_msg(conn, _OP_COMMIT, win_id, slot,
+                          (nchunks << 1) | acc, float(p), trace=trace)
+                wire_bytes += _HDR.size
+                _drain_acks(conn, outstanding + 1)
+            except socket.timeout as e:
+                self._evict(rank, conn)
+                raise self._timeout_error(rank, "write_chunked") from e
+            except (ConnectionError, OSError):
+                self._evict(rank, conn)
+                raise
+        if reg.enabled:
+            reg.counter("tcp.round_trips", op="write_chunked").inc()
+            reg.counter("tcp.acks").add(nchunks + 1)
+            reg.counter("tcp.chunks_sent").add(nchunks)
+            reg.counter("tcp.bytes_sent").add(wire_bytes)
+            reg.counter("tcp.bytes_received").add(_HDR.size * (nchunks + 1))
+            # raw vs wire payload volume: the measured compression ratio
+            # (bench.py wire_compression_ratio) is wire/raw
+            reg.counter("tcp.raw_payload_bytes").add(arr.nbytes)
+            reg.counter("tcp.wire_payload_bytes").add(wire_bytes)
+            reg.histogram("tcp.rtt_s", op="write_chunked").observe(
+                (time.perf_counter_ns() - t0) / 1e9)
 
     def close(self):
         for c in self.conns.values():
@@ -665,10 +1138,31 @@ class TcpShmWindow:
         # trace words staged by trace_stamp, consumed (popped) by the
         # immediately-following write() — same-thread call pattern
         self._trace_out: Dict[Tuple[int, int], int] = {}
+        # error-feedback residuals, one per (dst, slot) out-edge, created
+        # lazily when a quantized wire dtype is configured — the carry
+        # survives edge demotion (flushed on the next deposit) and only
+        # dies with the window (or the peer)
+        self._residual: Dict[Tuple[int, int], np.ndarray] = {}
 
     # -- local (owner-side) ops --------------------------------------------
     def _store(self) -> _WinStore:
         return self.rt.server.windows[self._id]
+
+    def _await_settled(self, s: _Slot) -> None:
+        """Wait out a mid-flight chunk stream (``wseq`` odd) before a
+        payload read/reset — the commit or the dead-writer drain
+        notifies.  Caller holds ``store_cond`` (== the server lock, which
+        ``wait`` releases while blocked, so chunk frames keep landing)."""
+        if not s.wseq & 1:
+            return
+        deadline = time.monotonic() + (peer_timeout_s() or 120.0)
+        while s.wseq & 1:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise RuntimeError(
+                    "mid-stream deposit never settled (writer alive but "
+                    "stalled past BFTPU_PEER_TIMEOUT_S)")
+            self.rt.server.store_cond.wait(min(left, 0.2))
 
     def trace_stamp(self, dst: int, slot: int, word: int,
                     writer=None) -> None:
@@ -684,26 +1178,71 @@ class TcpShmWindow:
 
     def read(self, slot: int, collect: bool = False, src=None):
         del src
-        with self.rt.server.lock:
+        srv = self.rt.server
+        with srv.store_cond:
             s = self._store().mail[slot]
-            a = np.frombuffer(bytes(s.data), self.dtype).reshape(self.shape)
-            p, ver = s.p, s.version
+            self._await_settled(s)
+            if s.drained == s.version:
+                # logically zero: the drained marker spares both the
+                # payload copy here and the memset on collect
+                a = np.zeros(self.shape, self.dtype)
+                p = 0.0
+            elif collect:
+                # collect takes the buffer itself (the slot is drained
+                # anyway) and swaps in a fresh zeroed one — O(1), no
+                # payload copy at all
+                raw = s.data
+                s.data = bytearray(self.nbytes)
+                a = np.frombuffer(raw, self.dtype).reshape(self.shape)
+                p = s.p
+            else:
+                a = np.frombuffer(s.data, self.dtype).reshape(
+                    self.shape).copy()
+                p = s.p
+            ver = s.version
             if collect:
-                s.data[:] = b"\x00" * self.nbytes
+                # collect == read + drain in ONE critical section
+                # (TCP_DRAINED_COLLECT_IS_ATOMIC)
+                s.drained = s.version
                 s.p = 0.0
-        return a.copy(), p, ver
+        return a, p, ver
 
     def read_version(self, slot: int, src=None) -> int:
+        # metadata-only: no _await_settled — a mid-stream slot reports
+        # its pre-stream version (the stream commits later, by design)
         del src
         with self.rt.server.lock:
             return self._store().mail[slot].version
 
     def reset(self, slot: int, src=None) -> None:
         del src
-        with self.rt.server.lock:
+        srv = self.rt.server
+        with srv.store_cond:
             s = self._store().mail[slot]
-            s.data[:] = b"\x00" * self.nbytes
+            self._await_settled(s)
+            s.drained = s.version
             s.p = 0.0
+
+    def force_drain(self, slot: int, src=None) -> None:
+        """Owner-side drain of a possibly-torn mail slot: the heal-path
+        hook islands' dead-writer accounting calls on every transport
+        (shm grew it in v2; this is the TCP twin).  Safe on a settled
+        slot (just drops pending mass); on a mid-stream slot it applies
+        ``TCP_DEAD_WRITER_DRAIN_STEPS`` without waiting for the
+        disconnect handler."""
+        del src
+        srv = self.rt.server
+        with srv.store_cond:
+            s = self._store().mail[slot]
+            if s.wseq & 1:
+                s.wseq += 1            # 1. evenize_wseq
+            s.drained = s.version      # 2. mark_drained
+            s.p = 0.0
+            srv.streams.pop((self._id, slot), None)  # 3. clear_stream
+            srv.store_cond.notify_all()
+        reg = _telemetry.get_registry()
+        if reg.enabled:
+            reg.counter("tcp.force_drains").inc()
 
     def expose(self, array, p: float = 1.0) -> None:
         a = np.ascontiguousarray(np.asarray(array, self.dtype))
@@ -712,9 +1251,13 @@ class TcpShmWindow:
                 f"expose payload has {a.nbytes} bytes but window "
                 f"expects {self.nbytes} (shape {self.shape})"
             )
+        try:
+            src = a.view(np.uint8).data  # zero-copy byte view
+        except (TypeError, ValueError):
+            src = a.tobytes()
         with self.rt.server.lock:
             s = self._store().exposed
-            s.data[:] = a.tobytes()
+            s.data[:] = src  # single copy into the slot
             s.p = float(p)
             s.version += 1
 
@@ -732,19 +1275,38 @@ class TcpShmWindow:
             )
         trace = self._trace_out.pop((int(dst), int(slot)), 0)
         if dst == self.rt.rank:
-            # local fast path, same semantics
+            # local fast path, same semantics (incl. the drained marker:
+            # accumulate onto a logically-zero slot is a put)
+            try:
+                src = a.view(np.uint8).data  # zero-copy byte view
+            except (TypeError, ValueError):
+                src = a.tobytes()
             with self.rt.server.lock:
                 s = self._store().mail[slot]
-                if accumulate:
-                    cur = np.frombuffer(bytes(s.data), self.dtype)
-                    s.data[:] = (cur + a.ravel()).tobytes()
+                if accumulate and s.drained != s.version:
+                    # in-place: frombuffer on the bytearray is writable
+                    cur = np.frombuffer(s.data, self.dtype)
+                    cur += a.ravel()
                     s.p += float(p)
                 else:
-                    s.data[:] = a.tobytes()
+                    s.data[:] = src
                     s.p = float(p)
                 s.version += 1
                 if trace:
                     s.trace = trace
+            return
+        if tcp_chunked() and a.size:
+            residual = None
+            if self.dtype.kind == "f" \
+                    and wire_codec.wire_code() != wire_codec.WIRE_RAW:
+                key = (int(dst), int(slot))
+                residual = self._residual.get(key)
+                if residual is None:
+                    residual = self._residual[key] = np.zeros(
+                        a.size, self.dtype)
+            self.rt.peers.deposit_chunked(
+                dst, self._id, slot, a, float(p), accumulate, trace,
+                residual=residual, src_rank=self.rt.rank)
             return
         try:
             # zero-copy byte view; the uint8 reinterpret also covers
@@ -761,7 +1323,7 @@ class TcpShmWindow:
         if src == self.rt.rank:
             with self.rt.server.lock:
                 s = self._store().exposed
-                a = np.frombuffer(bytes(s.data), self.dtype).reshape(self.shape)
+                a = np.frombuffer(s.data, self.dtype).reshape(self.shape)
                 return a.copy(), s.p, s.version
         _, _, ver, _, p, payload, _ = self.rt.peers.request(
             src, _OP_READ_EXPOSED, self._id
@@ -771,6 +1333,7 @@ class TcpShmWindow:
 
     def close(self, unlink: bool = False) -> None:
         del unlink
+        self._residual.clear()
         with self.rt.server.lock:
             self.rt.server.windows.pop(self._id, None)
 
