@@ -45,6 +45,11 @@ consumers (CLI, pytest, CI):
   ledger-consistent; mutex holder words always name a live member and
   clear on release/heal; the critical-path blame feed gating adaptive
   demotion stays monotone;
+- **sim** (:mod:`.sim_rules`) — the deterministic fleet simulator as a
+  verifier: pinned-seed fault campaigns over the real protocol state
+  machines finish clean (mass conserved, ledger balanced, consensus at
+  quiesce), the same seed replays bit-identically, and a seeded
+  invariant bug shrinks to its minimal schedule;
 - the **fixture corpus** (:mod:`.fixtures`) — seeded bugs proving every
   rule fires.
 
@@ -75,6 +80,7 @@ from bluefog_tpu.analysis import (  # noqa: F401
     progress_rules,
     resilience_rules,
     seqlock_model,
+    sim_rules,
     telemetry_rules,
     trace_rules,
     wire_rules,

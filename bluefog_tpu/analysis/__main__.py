@@ -88,8 +88,27 @@ def main(argv=None) -> int:
         if dead:
             print(f"self-test FAILED: rule(s) never fired for {dead}")
             return 1
+        # sim arm: the acceptance-size pinned campaigns must run clean
+        # (the default corpus only runs the small ones)
+        from bluefog_tpu.analysis import sim_rules
+
+        dirty = []
+        for label, res, findings in sim_rules.selftest_campaigns():
+            ok = not findings
+            print(f"  {label:<36s} "
+                  f"{'clean' if ok else 'VIOLATED'} "
+                  f"(events={res.events}, digest={res.digest[:12]})")
+            for f in findings:
+                print(f"    {f}")
+            if not ok:
+                dirty.append(label)
+        if dirty:
+            print(f"self-test FAILED: campaign(s) violated invariants "
+                  f"{dirty}")
+            return 1
         print(f"self-test OK: all {len(fixtures.FIXTURES)} seeded bugs "
-              "caught")
+              f"caught, {len(sim_rules.SELFTEST_PINS)} pinned campaigns "
+              "clean")
         return 0
 
     families = args.families

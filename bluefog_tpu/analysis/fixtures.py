@@ -528,6 +528,38 @@ def _progress_fusion_reorders() -> List[Finding]:
         subject="fixture[fusion-reorders]")
 
 
+def _sim_mass_leak() -> List[Finding]:
+    """A full campaign with a seeded 1e-3 multiplicative leak in the
+    combine path: the continuous mass audit must flag it (and nothing
+    else can — the leak never touches the count ledger)."""
+    from bluefog_tpu.analysis import sim_rules
+    from bluefog_tpu.sim.campaign import SimConfig, run_campaign
+
+    cfg = SimConfig(ranks=16, rounds=20, seed=3, quiesce_rounds=10,
+                    debug_bugs=("mass_leak",))
+    res = run_campaign(cfg)
+    return sim_rules.campaign_findings(res, "fixture[sim-mass-leak]")
+
+
+def _sim_cap_bypass() -> List[Finding]:
+    """A campaign whose adaptive step ignores the minority-demotion
+    cap, on a hand-written schedule slowing 5 of 8 ranks: with the cap
+    bypassed the fleet demotes a majority, which the standing
+    invariant must flag (the same schedule without the seeded bug runs
+    clean — the cap is what protects it)."""
+    from bluefog_tpu.analysis import sim_rules
+    from bluefog_tpu.sim.campaign import SimConfig, run_campaign
+    from bluefog_tpu.sim.schedule import Fault, FaultSchedule
+
+    cfg = SimConfig(ranks=8, rounds=40, seed=5, quiesce_rounds=20,
+                    faults=("slow",), debug_bugs=("cap_bypass",))
+    sched = FaultSchedule(
+        [Fault(kind="slow", step=3 + i, rank=i, duration_s=1.0, stop=35)
+         for i in range(5)], seed=5)
+    res = run_campaign(cfg, sched)
+    return sim_rules.campaign_findings(res, "fixture[sim-cap-bypass]")
+
+
 FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
     # plan family
     "plan-duplicate-destination": _plan_duplicate_destination,
@@ -599,6 +631,9 @@ FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
     "introspect-torn-page": _introspect_torn_page,
     "introspect-ghost-holder": _introspect_ghost_holder,
     "introspect-blame-regression": _introspect_blame_regression,
+    # sim family: seeded invariant bugs a full campaign must catch
+    "sim-mass-leak": _sim_mass_leak,
+    "sim-cap-bypass": _sim_cap_bypass,
     # trace family: crossed spans, corrupted flow identity, clock skew
     "trace-unbalanced-nesting": _trace_unbalanced_nesting,
     "trace-dangling-flow": _trace_dangling_flow,

@@ -198,32 +198,18 @@ def check_mixing_stochastic(plan: CommPlan, label: str = "plan",
                             tol: float = _TOL) -> List[Finding]:
     """Rows of the reconstructed W must sum to 1 (convergence to *a*
     consensus); columns too when the constructor promises it (convergence
-    to the *average*); entries must be non-negative."""
-    out: List[Finding] = []
-    W = plan.mixing_matrix()
-    rows = W.sum(axis=1)
-    bad_rows = np.flatnonzero(np.abs(rows - 1.0) > tol)
-    if bad_rows.size:
-        out.append(Finding(
-            "plan.mixing-stochastic", label,
-            f"row(s) {bad_rows[:6].tolist()} sum to "
-            f"{rows[bad_rows[:6]].tolist()} (expected 1±{tol}) — gossip "
-            "would not converge to a consensus"))
-    if expect_column:
-        cols = W.sum(axis=0)
-        bad_cols = np.flatnonzero(np.abs(cols - 1.0) > tol)
-        if bad_cols.size:
-            out.append(Finding(
-                "plan.mixing-stochastic", label,
-                f"column(s) {bad_cols[:6].tolist()} sum to "
-                f"{cols[bad_cols[:6]].tolist()} (expected 1±{tol}) — the "
-                "fixed point drifts away from the true average"))
-    if (W < -tol).any():
-        neg = np.argwhere(W < -tol)[:6].tolist()
-        out.append(Finding(
-            "plan.mixing-stochastic", label,
-            f"negative mixing weight(s) at {neg}"))
-    return out
+    to the *average*); entries must be non-negative.
+
+    The numeric core is shared with the fleet simulator's continuous
+    invariant audit (``sim.invariants.stochastic_violations``) — one
+    implementation of the property, checked offline on plans and online
+    on campaign topologies."""
+    from bluefog_tpu.sim.invariants import stochastic_violations
+
+    return [Finding("plan.mixing-stochastic", label, msg)
+            for msg in stochastic_violations(
+                plan.mixing_matrix(), expect_column=expect_column,
+                tol=tol)]
 
 
 def spectral_gap(W: np.ndarray) -> float:

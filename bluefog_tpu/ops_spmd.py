@@ -86,6 +86,7 @@ def neighbor_allreduce(
     self_weight: Optional[float] = None,
     average_dtype=None,
     fuse: bool = False,
+    rank_index=None,
 ):
     """Weighted neighbor averaging: ``out_d = w_dd * x_d + sum_{s in N_in(d)}
     w_ds * x_s`` — the reference's hot path (SURVEY.md §3.2).
@@ -94,6 +95,14 @@ def neighbor_allreduce(
     constant vectors indexed by ``axis_index`` so a single compiled program
     serves every rank (SPMD).  ``self_weight`` overrides the plan's per-rank
     self weights uniformly.
+
+    ``rank_index`` optionally supplies this rank's index along
+    ``axis_name`` as a traced scalar (e.g. the caller's shard of a
+    mesh-sharded iota).  Inside a PARTIALLY-manual ``shard_map`` (some
+    mesh axes still auto) ``lax.axis_index`` lowers to a
+    ``partition-id`` instruction, which the SPMD partitioner rejects
+    on some backends (CPU raises UNIMPLEMENTED); a sharded-iota
+    operand is the partitioner-friendly spelling of the same value.
 
     ``fuse=True`` packs same-dtype leaves into ONE flat buffer before
     permuting — the reference's fusion buffer (``BLUEFOG_FUSION_THRESHOLD``,
@@ -108,7 +117,7 @@ def neighbor_allreduce(
 
     def nar(a):
         wdt = average_dtype or _weight_dtype(a)
-        idx = lax.axis_index(axis_name)
+        idx = lax.axis_index(axis_name) if rank_index is None else rank_index
         if self_weight is None:
             sw = jnp.asarray(plan.self_weights, dtype=wdt)[idx]
         else:

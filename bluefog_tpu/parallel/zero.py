@@ -585,16 +585,32 @@ def make_fsdp_gossip_train_step(
                 # that broke the 8B/32-layer budget.  ppermute keeps one
                 # in-flight shard + accumulator per leaf.  Same W by
                 # construction (machine_plan IS the matrix's source).
-                def _mix_body(t):
+                # FULLY manual over both mesh axes (the local shard rides
+                # through untouched — permute + weighted sum is
+                # elementwise-linear, so permuting each local shard
+                # independently IS the leaf permute).  A machines-manual/
+                # local-auto spelling leaves the partitioner to rewrite
+                # the region, and its reshard of a collective operand
+                # between manual-subgroup and auto shardings is broken on
+                # the CPU backend (CHECK in spmd_partitioner.cc); the
+                # machine index rides in as a sharded iota rather than
+                # lax.axis_index for the same reason (partition-id).
+                def _mix_body(t, midx):
                     sq = jax.tree_util.tree_map(lambda a: a[0], t)
                     mixed = ops_spmd.neighbor_allreduce(
-                        sq, plan=machine_plan, axis_name=MACHINES_AXIS)
+                        sq, plan=machine_plan, axis_name=MACHINES_AXIS,
+                        rank_index=midx[0])
                     return jax.tree_util.tree_map(lambda a: a[None], mixed)
 
+                midx = jnp.arange(machines, dtype=jnp.int32)
+                mix_specs = jax.tree_util.tree_map(
+                    lambda a: _fsdp_spec(a.shape[1:], local), master)
                 master = jax.shard_map(
                     _mix_body, mesh=hier_mesh,
-                    in_specs=P(MACHINES_AXIS), out_specs=P(MACHINES_AXIS),
-                    axis_names=frozenset({MACHINES_AXIS}))(master)
+                    in_specs=(mix_specs, P(MACHINES_AXIS)),
+                    out_specs=mix_specs,
+                    axis_names=frozenset({MACHINES_AXIS, LOCAL_AXIS}))(
+                        master, midx)
                 master = jax.tree_util.tree_map(
                     lambda a: lax.with_sharding_constraint(
                         a, _sharding(a.shape[1:])), master)

@@ -63,6 +63,7 @@ import time
 from typing import Optional
 
 from bluefog_tpu.resilience.detector import EdgeHealth
+from bluefog_tpu.sim.clock import now_fn as _now_fn
 from bluefog_tpu.telemetry import registry as _telemetry
 
 __all__ = [
@@ -121,7 +122,7 @@ class AdaptivePolicy:
                        else float(factor))
         self.min_obs = MIN_OBSERVATIONS if min_obs is None else int(min_obs)
         self.health = EdgeHealth(clock=clock) if health is None else health
-        self._clock = clock
+        self._clock = _now_fn(clock)
         self._lock = threading.Lock()
         # bare histograms (no registry): pooled over ALL edges — the
         # healthy-cadence baseline the per-edge deadline compares against

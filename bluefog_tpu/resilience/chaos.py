@@ -36,6 +36,8 @@ import signal
 import time
 from typing import Optional
 
+from bluefog_tpu.sim.clock import resolve_clock as _resolve_clock
+
 __all__ = [
     "kill",
     "suspend",
@@ -47,7 +49,10 @@ __all__ = [
     "schedule_join",
     "schedule_suspend",
     "schedule_slow",
+    "schedule_to_json",
+    "apply_schedule_json",
     "clear_schedule",
+    "set_clock",
     "corrupt_chunk",
 ]
 
@@ -68,6 +73,29 @@ _ALL_KEYS = (_KILL_RANK, _KILL_STEP, _DELAY_S,
              _JOIN_RANK, _JOIN_STEP,
              _SUSPEND_RANK, _SUSPEND_STEP, _SUSPEND_S,
              _SLOW_RANK, _SLOW_STEP, _SLOW_S, _SLOW_STOP)
+
+# sim-campaign knobs (bluefog_tpu/sim/__main__.py reads these as CLI
+# defaults) — scrubbed by clear_schedule() alongside the chaos keys,
+# because a stale campaign seed or schedule would replay faults into
+# the next test's campaign exactly like a stale kill schedule would
+_SIM_KEYS = ("BFTPU_SIM_SEED", "BFTPU_SIM_RANKS", "BFTPU_SIM_ROUNDS",
+             "BFTPU_SIM_FAULTS", "BFTPU_SIM_TOPOLOGY",
+             "BFTPU_SIM_SCHEDULE", "BFTPU_SIM_QUIESCE_ROUNDS",
+             "BFTPU_SIM_LATENCY_MS", "BFTPU_SIM_REPRO_DIR")
+
+# injectable clock (sim/clock.py seam) for the delay/straggler sleeps;
+# process-level signals (suspend_self) always use wall time — you
+# cannot virtualize a SIGSTOP
+_clock = _resolve_clock(None)
+
+
+def set_clock(clock=None) -> None:
+    """Install the clock used by :func:`checkpoint`'s scheduled sleeps
+    (``None`` restores wall time).  The simulator installs its virtual
+    clock so a chaos schedule replayed inside a campaign burns virtual
+    seconds, not wall seconds."""
+    global _clock
+    _clock = _resolve_clock(clock)
 
 
 def kill(pid: int) -> None:
@@ -162,11 +190,33 @@ def schedule_slow(env: dict, rank: int, step: int, delay_s: float,
     return env
 
 
+def schedule_to_json() -> str:
+    """Serialize the calling process's env-published chaos schedule to
+    the shared fault-schedule JSON (see
+    :class:`bluefog_tpu.sim.schedule.FaultSchedule`) — the round-trip
+    that lets a flaky chaos e2e be replayed as a deterministic sim
+    campaign."""
+    from bluefog_tpu.sim.schedule import FaultSchedule
+
+    return FaultSchedule.from_env(os.environ).to_json()
+
+
+def apply_schedule_json(payload: str, env: Optional[dict] = None) -> dict:
+    """Publish a shared-format JSON fault schedule into ``env``
+    (default: this process's environment) as chaos keys — the inverse
+    of :func:`schedule_to_json`."""
+    from bluefog_tpu.sim.schedule import FaultSchedule
+
+    return FaultSchedule.from_json(payload).to_env(
+        os.environ if env is None else env)
+
+
 def clear_schedule() -> None:
     """Scrub EVERY chaos key from the calling process's environment —
     kill, join, and suspend schedules alike (a stale key would replay
-    the fault in the next test's workers)."""
-    for k in _ALL_KEYS:
+    the fault in the next test's workers) — plus the sim-campaign
+    keys, which are schedules by another name."""
+    for k in _ALL_KEYS + _SIM_KEYS:
         os.environ.pop(k, None)
 
 
@@ -190,14 +240,14 @@ def checkpoint(rank: int, tag: str = "step") -> None:
         return
     delay = env.get(_DELAY_S)
     if delay:
-        time.sleep(float(delay))
+        _clock.sleep(float(delay))
     key = (int(rank), tag)
     n = _counters.get(key, 0) + 1
     _counters[key] = n
     if _matches(env.get(_SLOW_RANK), rank) \
             and n >= int(env.get(_SLOW_STEP, "1")) \
             and (_SLOW_STOP not in env or n < int(env[_SLOW_STOP])):
-        time.sleep(float(env.get(_SLOW_S, "0.5")))
+        _clock.sleep(float(env.get(_SLOW_S, "0.5")))
     if _matches(env.get(_SUSPEND_RANK), rank) \
             and n == int(env.get(_SUSPEND_STEP, "1")):
         suspend_self(float(env.get(_SUSPEND_S, "2.5")))

@@ -23,9 +23,9 @@ Two primitives:
 from __future__ import annotations
 
 import os
-import time
 from typing import Callable, Dict, Iterable, Tuple, TypeVar
 
+from bluefog_tpu.sim.clock import resolve_clock as _resolve_clock
 from bluefog_tpu.telemetry import registry as _telemetry
 
 __all__ = [
@@ -55,7 +55,8 @@ def op_deadline_s() -> float:
 def with_deadline(fn: Callable[[float], T], describe: str,
                   deadline: float = None, retries: int = 2,
                   backoff: float = 0.05,
-                  on_timeout: Callable[[], None] = None) -> T:
+                  on_timeout: Callable[[], None] = None,
+                  clock=None) -> T:
     """Call ``fn(remaining_seconds)`` under a total deadline.
 
     ``fn`` receives the per-attempt budget and must raise TimeoutError
@@ -63,7 +64,10 @@ def with_deadline(fn: Callable[[float], T], describe: str,
     ``on_timeout`` runs (the hook where the caller consults the failure
     detector and heals) and the backoff doubles.  After ``retries``
     failed attempts, DeadlineExceeded is raised naming the op.
+    ``clock`` is the sim/clock.py seam for the backoff pause; ``None``
+    is wall time.
     """
+    clk = _resolve_clock(clock)
     total = op_deadline_s() if deadline is None else float(deadline)
     per_attempt = total / max(1, retries)
     pause = backoff
@@ -79,7 +83,7 @@ def with_deadline(fn: Callable[[float], T], describe: str,
             if on_timeout is not None:
                 on_timeout()
             if attempt + 1 < max(1, retries):
-                time.sleep(pause)
+                clk.sleep(pause)
                 pause *= 2
     reg = _telemetry.get_registry()
     if reg.enabled:

@@ -34,6 +34,7 @@ import threading
 import time
 from typing import Optional, Set
 
+from bluefog_tpu.sim.clock import now_fn as _now_fn
 from bluefog_tpu.telemetry import registry as _telemetry
 from bluefog_tpu.tracing import tracer as _tracing
 
@@ -157,7 +158,7 @@ class EdgeHealth:
         self.misses = suspect_misses() if misses is None else int(misses)
         self.clean = promote_clean() if clean is None else int(clean)
         self.floor_s = demote_floor_s() if floor_s is None else float(floor_s)
-        self._clock = clock
+        self._clock = _now_fn(clock)
         self._lock = threading.Lock()
         self._state: dict = {}        # peer -> state string
         self._miss_streak: dict = {}  # peer -> consecutive misses
@@ -279,7 +280,8 @@ class FailureDetector:
 
     def __init__(self, job, rank: int, nranks: int,
                  timeout: Optional[float] = None,
-                 interval: Optional[float] = None):
+                 interval: Optional[float] = None,
+                 clock=None):
         self._job = job
         self.rank = int(rank)
         self.nranks = int(nranks)
@@ -288,7 +290,10 @@ class FailureDetector:
                          else interval)
         self._supported = (hasattr(job, "heartbeat")
                            and hasattr(job, "liveness"))
-        self._born = time.monotonic()
+        # injectable monotonic clock (sim/clock.py seam): ``None`` is
+        # wall time — production behavior unchanged
+        self._clock = _now_fn(clock)
+        self._born = self._clock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._declared: Set[int] = set()
@@ -349,7 +354,7 @@ class FailureDetector:
             stamp = float(self._job.liveness(rank))
         except Exception:
             return True
-        now = time.monotonic()
+        now = self._clock()
         if stamp <= 0.0:
             # never beat: startup grace measured from detector birth
             alive = now - self._born <= self.timeout

@@ -59,6 +59,7 @@ import networkx as nx
 
 from bluefog_tpu.native import shm_native
 from bluefog_tpu.resilience import healing as _healing
+from bluefog_tpu.sim.clock import resolve_clock as _resolve_clock
 
 __all__ = [
     "BOARD_SCHEMA",
@@ -146,8 +147,11 @@ class MembershipBoard:
     rename — readers never see a torn JSON.
     """
 
-    def __init__(self, job: str):
+    def __init__(self, job: str, clock=None):
         self.job = job
+        # injectable clock (sim/clock.py seam) for the grant-poll loop;
+        # ``None`` is wall time — production behavior unchanged
+        self._clock = _resolve_clock(clock)
         base = shm_native.seg_name(job, "membership")[1:]
         self.path = os.path.join(shm_native._FALLBACK_DIR, base)
         self.lock_path = self.path + ".lock"
@@ -184,6 +188,14 @@ class MembershipBoard:
                 os.close(fd)
 
         return cm()
+
+    def _publish_epoch_word(self, epoch: int) -> None:
+        """Publish the 8-byte membership-epoch word — the cheap
+        has-anything-changed probe members poll at round barriers.
+        Separated out so a transport that keeps its epoch word
+        somewhere other than the shm segment (the simulator's
+        in-memory board) can override just this."""
+        shm_native.publish_membership_epoch(self.job, int(epoch))
 
     # -- lifecycle --------------------------------------------------------
 
@@ -226,8 +238,8 @@ class MembershipBoard:
     def wait_for_grant(self, req_id: str,
                        timeout: Optional[float] = None) -> JoinGrant:
         """Poll until some epoch record grants ``req_id`` a rank."""
-        deadline = time.monotonic() + (join_timeout_s()
-                                       if timeout is None else timeout)
+        deadline = self._clock.deadline(join_timeout_s()
+                                        if timeout is None else timeout)
         poll = join_poll_s()
         while True:
             doc = self.read()
@@ -242,12 +254,12 @@ class MembershipBoard:
                             sponsor=int(rec["sponsor"]),
                             record=rec,
                         )
-            if time.monotonic() >= deadline:
+            if self._clock.expired(deadline):
                 raise TimeoutError(
                     f"join request {req_id} not granted within timeout "
                     f"(job {self.job!r}; is any member calling "
                     "islands.admit_pending()?)")
-            time.sleep(poll)
+            self._clock.sleep(poll)
 
     # -- sponsor side -----------------------------------------------------
 
@@ -316,7 +328,7 @@ class MembershipBoard:
             doc["requests"] = []
             self._publish(doc)
         # the cheap probe members poll at round barriers
-        shm_native.publish_membership_epoch(self.job, new_epoch)
+        self._publish_epoch_word(new_epoch)
         return rec
 
     # -- adaptive-topology side (resilience/adaptive.py) ------------------
@@ -367,5 +379,5 @@ class MembershipBoard:
             doc["epochs"].append(rec)
             doc["epoch"] = new_epoch
             self._publish(doc)
-        shm_native.publish_membership_epoch(self.job, new_epoch)
+        self._publish_epoch_word(new_epoch)
         return rec

@@ -1,0 +1,833 @@
+"""The fleet driver: N simulated ranks running the REAL protocol.
+
+Each :class:`SimRank` owns the same state-machine objects a live
+island rank owns — a :class:`~bluefog_tpu.resilience.detector.
+FailureDetector` over the transport's liveness words, an
+:class:`~bluefog_tpu.resilience.detector.EdgeHealth` gray-failure
+machine keyed by global rank, an :class:`~bluefog_tpu.resilience.
+adaptive.AdaptivePolicy` fed deposit-gap observations off the mailbox
+versions, and the shared :class:`~bluefog_tpu.sim.transport.SimBoard`
+(the real ``MembershipBoard`` protocol methods).  Topology changes go
+through the real planners (``heal_topology`` / ``grow_topology`` /
+``demote_topology`` / ``record_graph``), memoized fleet-wide — the
+planners are pure, so every rank that heals the same view shares one
+compile.
+
+The gossip itself is scalar push-sum: each rank's round collects its
+in-slots (all-ones collect rows: a late deposit is simply picked up
+next round — the mass-conserving plain drop of ``islands.win_update``
+ABSORB), then deposits ``W[v,u]·x`` to each out-neighbor and keeps
+the column residual — so Σx and Σp are conserved by construction and
+the transport can audit conservation after every event.
+
+Faults (from a :class:`~bluefog_tpu.sim.schedule.FaultSchedule`) fire
+on the victim's own round counter, exactly like
+``chaos.checkpoint`` counts steps:
+
+- ``kill`` — the rank's mass is seized to the lost bucket, its
+  in-slots sever, survivors detect via heartbeat timeout and run the
+  heal/settlement path;
+- ``suspend`` — heartbeats and rounds stall for ``duration_s``; past
+  the failure timeout the fleet declares it dead and a resumed zombie
+  finds itself fenced (adopted) and exits;
+- ``slow`` — the round cadence stretches by ``duration_s`` while
+  heartbeats keep beating: the gray failure only the adaptive
+  edge-health machine catches (demote to anchor, promote on
+  recovery);
+- ``join`` — a joiner posts on the board and blocks in
+  ``wait_for_grant`` on the virtual clock; the sponsor (lowest live
+  global rank) grants via the real ``grant`` path and the joiner
+  enters with unit mass at the sponsor's debiased estimate.
+
+Invariants are checked after every protocol event (see
+:mod:`bluefog_tpu.sim.invariants`); violations are recorded, never
+raised — the campaign layer decides whether to shrink.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Dict, List, Optional, Set, Tuple
+
+import networkx as nx
+
+from bluefog_tpu.resilience import healing as _healing
+from bluefog_tpu.resilience.adaptive import AdaptivePolicy
+from bluefog_tpu.resilience.detector import (
+    EDGE_ALIVE, EdgeHealth, FailureDetector)
+from bluefog_tpu.resilience.join import record_graph
+from bluefog_tpu.sim import invariants as _inv
+from bluefog_tpu.sim.events import EventLoop, VirtualClock
+from bluefog_tpu.sim.schedule import Fault, FaultSchedule
+from bluefog_tpu.sim.transport import SimBoard, SimTransport
+
+__all__ = ["SimRank", "SimFleet"]
+
+_T0 = 1.0  # virtual launch instant (nonzero: a 0.0 heartbeat stamp
+           # would read as "never beat" to the detector)
+
+
+class SimRank:
+    """One simulated rank: real state machines + scalar push-sum."""
+
+    def __init__(self, g: int, x: float, p: float):
+        self.g = int(g)
+        self.x = float(x)
+        self.p = float(p)
+        self.epoch = 0
+        self.epoch_members: Tuple[int, ...] = ()
+        self.members: Tuple[int, ...] = ()
+        self.graph: Optional[nx.DiGraph] = None
+        self.base_key = None          # memo key of the pre-demotion base
+        self.cfg_key = None           # memo key of the current topology
+        self.known_dead: Set[int] = set()
+        self.demoted: Set[int] = set()
+        self.round_idx = 0
+        self.done = False
+        self.suspended_until = 0.0
+        self.slow_until_step: Optional[int] = None
+        self.slow_delay = 0.0
+        self.exited = False
+        self.killed = False
+        self.detector: Optional[FailureDetector] = None
+        self.health: Optional[EdgeHealth] = None
+        self.policy: Optional[AdaptivePolicy] = None
+        # per in-edge adaptive probe state: src_g -> [version, t, missed]
+        self.edge_seen: Dict[int, list] = {}
+
+    @property
+    def estimate(self) -> float:
+        return self.x / self.p if self.p > 0 else float("nan")
+
+    def live_members(self) -> List[int]:
+        return [m for m in self.members if m not in self.known_dead]
+
+
+class SimFleet:
+    """Drives ``cfg.ranks`` SimRanks through ``cfg.rounds`` fault-laden
+    rounds plus ``cfg.quiesce_rounds`` clean ones on one event loop."""
+
+    def __init__(self, cfg, schedule: Optional[FaultSchedule] = None):
+        self.cfg = cfg
+        self.schedule = schedule or FaultSchedule()
+        self.loop = EventLoop(start=_T0)
+        self.clock = VirtualClock(self.loop)
+        self.transport = SimTransport(self.loop, self.clock)
+        self.board = SimBoard(cfg.job, self.transport)
+        self.rng = random.Random(int(cfg.seed) ^ 0x5EED0F)
+        self.event_log: List[tuple] = []
+        self.violations: List[dict] = []
+        self._epoch_word_seen = 0
+        self._topo_cache: Dict[object, tuple] = {}
+        # graphs already audited doubly stochastic (id -> graph ref)
+        self._graphs_ok: Dict[int, object] = {}
+        # committed epoch records, cached fleet-wide (read-only)
+        self._epoch_recs: Dict[int, dict] = {}
+        self._registries: Dict[int, object] = {}
+        self.ranks: Dict[int, SimRank] = {}
+        self.joiners_spawned = 0
+        # faults indexed by (victim global rank, step); joins by step
+        self._faults: Dict[Tuple[int, int], Fault] = {}
+        self._join_faults: List[Fault] = []
+        for f in self.schedule:
+            if f.kind == "join":
+                self._join_faults.append(f)
+            else:
+                self._faults[(f.rank, f.step)] = f
+        self._build()
+
+    # -- construction ------------------------------------------------------
+
+    def _mk_registry(self, g: int):
+        if not self.cfg.journal_dir:
+            return None
+        reg = self._registries.get(g)
+        if reg is None:
+            from bluefog_tpu.telemetry.registry import Registry
+
+            reg = Registry(out_dir=self.cfg.journal_dir, rank=g,
+                           job=self.cfg.job)
+            self._registries[g] = reg
+        return reg
+
+    def _journal(self, g: int, event: str, **fields) -> None:
+        reg = self._mk_registry(g)
+        if reg is not None and reg.enabled:
+            reg.journal(event, **fields)
+
+    def _base_topology(self) -> nx.DiGraph:
+        from bluefog_tpu import topology_util as tu
+
+        n = int(self.cfg.ranks)
+        builders = {
+            "exp2": tu.ExponentialTwoGraph,
+            "exp": tu.ExponentialGraph,
+            "ring": tu.RingGraph,
+            "star": tu.StarGraph,
+            "full": tu.FullyConnectedGraph,
+        }
+        try:
+            build = builders[self.cfg.topology]
+        except KeyError:
+            raise ValueError(
+                f"unknown sim topology {self.cfg.topology!r} "
+                f"(one of {sorted(builders)})") from None
+        return build(n)
+
+    def _rows(self, G: nx.DiGraph):
+        """Per-local-rank send rows: (keep_fraction, [(dst_local, w)]).
+        Edge (u, v) carries W[v, u] — the weight v applies to u's
+        value — so u's column residual is 1 - Σ out-weights; with the
+        MH weights doubly stochastic, depositing ``w·x`` per edge and
+        keeping the residual conserves Σx exactly (up to fp)."""
+        rows = {}
+        for u in sorted(G.nodes):
+            out = [(int(v), float(G[u][v]["weight"]))
+                   for v in sorted(G.successors(u))]
+            keep = 1.0 - sum(w for _, w in out)
+            rows[int(u)] = (keep, out)
+        return rows
+
+    def _topo_entry(self, key, build):
+        """Fleet-wide memo for pure topology computations: every rank
+        healing/adopting the same view shares one planner run (the
+        planners cost ~70 ms at N=256 — per-rank recompute would
+        dominate the whole campaign)."""
+        ent = self._topo_cache.get(key)
+        if ent is None:
+            ent = self._topo_cache[key] = build()
+        return ent
+
+    def _build(self) -> None:
+        cfg = self.cfg
+        G = self._topo_entry(("epoch", 0), lambda: self._base_topology())
+        members = tuple(range(cfg.ranks))
+        rows = self._rows(G)
+        self.board.ensure(cfg.ranks)
+        for g in range(cfg.ranks):
+            r = SimRank(g, x=float(g), p=1.0)
+            r.members = r.epoch_members = members
+            r.graph = G
+            r.cfg_key = r.base_key = ("epoch", 0)
+            self.ranks[g] = r
+            self._wire_rank(r)
+        self.initial_x = sum(r.x for r in self.ranks.values())
+        self.initial_p = sum(r.p for r in self.ranks.values())
+        self.joined_x = 0.0
+        self.joined_p = 0.0
+        self._rows_cache = {("epoch", 0): rows}
+        # stagger starts so rounds interleave like free-running
+        # processes (deterministically)
+        for g in range(cfg.ranks):
+            off = (g * 37 % 101) / 101.0
+            self.loop.at(_T0 + off * cfg.hb_interval, self._hb_event(g))
+            self.loop.at(_T0 + off * cfg.round_period,
+                         self._round_event(g))
+        for f in self._join_faults:
+            self.loop.at(_T0 + f.step * cfg.round_period,
+                         self._joiner_event(f))
+        self.end_time = _T0 + (cfg.rounds + cfg.quiesce_rounds + 2) \
+            * cfg.round_period
+
+    def _wire_rank(self, r: SimRank) -> None:
+        cfg = self.cfg
+        view = self.transport.job_view(r.epoch_members, r.g)
+        local = r.epoch_members.index(r.g)
+        r.detector = FailureDetector(
+            view, local, len(r.epoch_members),
+            timeout=cfg.hb_timeout, interval=cfg.hb_interval,
+            clock=self.clock.now)
+        if r.health is None:
+            r.health = EdgeHealth(misses=cfg.suspect_misses,
+                                  clean=cfg.promote_clean,
+                                  floor_s=cfg.demote_floor_s,
+                                  clock=self.clock.now)
+            r.policy = AdaptivePolicy(floor_s=cfg.edge_deadline_floor_s,
+                                      factor=cfg.edge_deadline_factor,
+                                      min_obs=cfg.adaptive_min_obs,
+                                      health=r.health,
+                                      clock=self.clock.now)
+        r.detector.edge_health = r.health
+        members = r.epoch_members
+        r.detector.to_peer = lambda lr, _m=members: _m[lr]
+
+    # -- event bodies ------------------------------------------------------
+
+    def _all_done(self) -> bool:
+        """Every live rank has finished its rounds — the campaign's
+        quiesce point.  Heartbeats must keep beating until HERE, not
+        until a fixed wall time: rounds stretch under slow faults and
+        suspensions, and a straggler still running rounds after its
+        peers stopped stamping would declare the whole fleet dead."""
+        return all(r.done or r.killed or r.exited
+                   for r in self.ranks.values())
+
+    def _hb_event(self, g: int):
+        def fire():
+            r = self.ranks.get(g)
+            if r is None or r.killed or r.exited:
+                return
+            if self._all_done():
+                return
+            if self.loop.now >= r.suspended_until:
+                r.detector.beat()
+            self.loop.after(self.cfg.hb_interval, self._hb_event(g))
+        return fire
+
+    def _round_event(self, g: int):
+        def fire():
+            r = self.ranks.get(g)
+            if r is None or r.killed or r.exited:
+                return
+            now = self.loop.now
+            if now < r.suspended_until:
+                self.loop.at(r.suspended_until, self._round_event(g))
+                return
+            r.round_idx += 1
+            step = r.round_idx
+            if step > self.cfg.rounds + self.cfg.quiesce_rounds:
+                r.done = True
+                return
+            fault = self._faults.get((g, step))
+            if fault is not None and self._apply_fault(r, fault):
+                return  # killed (or suspended: round deferred)
+            self._round_body(r)
+            delay = 0.0
+            if (r.slow_until_step is not None
+                    and step >= 0 and r.round_idx < r.slow_until_step):
+                delay = r.slow_delay
+            elif (r.slow_until_step is not None
+                  and r.round_idx >= r.slow_until_step):
+                r.slow_until_step = None
+                self._log("slow_end", r.g)
+            self.loop.after(self.cfg.round_period + delay,
+                            self._round_event(g))
+        return fire
+
+    def _apply_fault(self, r: SimRank, f: Fault) -> bool:
+        """Returns True when the round body must not run (kill or
+        suspend — a stopped process executes nothing)."""
+        if f.kind == "kill":
+            self._log("kill", r.g, step=r.round_idx)
+            r.killed = True
+            self.transport.kill(r.g)
+            self.transport.lost_x += r.x
+            self.transport.lost_p += r.p
+            r.x = 0.0
+            r.p = 0.0
+            self._check("kill", r.g)
+            return True
+        if f.kind == "suspend":
+            dur = f.duration_s or 2.5
+            self._log("suspend", r.g, step=r.round_idx, duration=dur)
+            r.suspended_until = self.loop.now + dur
+            self.loop.at(r.suspended_until, self._round_event(r.g))
+            self._check("suspend", r.g)
+            return True
+        if f.kind == "slow":
+            self._log("slow_start", r.g, step=r.round_idx,
+                      delay=f.duration_s)
+            r.slow_delay = f.duration_s or 0.5
+            r.slow_until_step = f.stop if f.stop is not None else 10 ** 9
+            return False
+        return False
+
+    def _round_body(self, r: SimRank) -> None:
+        # 1. failure detection -> heal
+        dead_local = r.detector.dead_ranks()
+        dead_global = {r.epoch_members[d] for d in dead_local}
+        new_dead = dead_global - r.known_dead
+        if new_dead:
+            self._heal(r, new_dead)
+        # 2. membership-epoch probe (the cheap word, then the board)
+        self._probe_epochs(r)
+        if r.exited:
+            return
+        # 3. sponsor-side admission (every round, like a round barrier
+        # with a chaos join schedule of rank=-1).  The transport-level
+        # flag (kept current by SimBoard._publish) makes the common
+        # no-joiner round skip the board's JSON parse entirely.
+        if self.transport.join_pending and self.board.pending_requests():
+            live = r.live_members()
+            if live and r.g == min(live):
+                self._grant(r)
+        # 4. adaptive demote/promote
+        if self.cfg.adaptive:
+            self._adaptive_step(r)
+        # 5. combine whatever the in-slots hold
+        self._combine(r)
+        # 6. deposit this round's shares
+        self._send(r)
+        # 7. continuous audit: the lowest live rank checks the global
+        # mass balance once per round (every protocol event above
+        # checked it already; this catches combine/send-path leaks)
+        live = r.live_members()
+        if live and r.g == min(live):
+            self._check("round", r.g)
+
+    # -- membership machinery ---------------------------------------------
+
+    def _heal(self, r: SimRank, new_dead: Set[int]) -> None:
+        for d in sorted(new_dead):
+            settlement = self.transport.heal_settle(r.g, d, r.epoch)
+            self._journal(r.g, "heal", dead=[d], epoch=r.epoch,
+                          **settlement)
+        r.known_dead |= new_dead
+        dead_local = sorted(r.members.index(d) for d in new_dead
+                            if d in r.members)
+        if not dead_local:
+            self._log("heal", r.g, dead=sorted(new_dead), noop=True)
+            return
+        old_members = r.members
+        key = ("heal", r.cfg_key, tuple(dead_local))
+        healed = self._topo_entry(
+            key, lambda: _healing.heal_topology(r.graph, dead_local))
+        survivors = tuple(old_members[l] for l in healed.to_global)
+        if r.base_key == r.cfg_key:
+            r.base_key = key
+        else:
+            # demoted view: heal the pre-demotion base in parallel so a
+            # later promote restores from a corpse-free base
+            bkey = ("heal", r.base_key, tuple(dead_local))
+            base_graph = self._graph_of(r.base_key)
+            self._topo_entry(
+                bkey,
+                lambda: _healing.heal_topology(base_graph, dead_local))
+            r.base_key = bkey
+            r.demoted &= set(survivors)
+        r.members = survivors
+        r.graph = healed.topology
+        r.cfg_key = key
+        self._log("heal", r.g, dead=sorted(new_dead),
+                  members=len(survivors))
+        self._check("heal", r.g, graph=r.graph)
+
+    def _graph_of(self, key) -> nx.DiGraph:
+        ent = self._topo_cache[key]
+        if isinstance(ent, nx.DiGraph):
+            return ent
+        # planner results carry .topology
+        return ent.topology
+
+    def _rows_of(self, key, G: nx.DiGraph):
+        rows = self._rows_cache.get(key)
+        if rows is None:
+            rows = self._rows_cache[key] = self._rows(G)
+        return rows
+
+    def _probe_epochs(self, r: SimRank) -> None:
+        """Adopt every committed epoch past mine.  Committed records
+        are immutable, so the first prober's board read is shared
+        fleet-wide (adopters only READ the record)."""
+        while self.transport.epoch_word > r.epoch and not r.exited:
+            rec = self._epoch_recs.get(r.epoch + 1)
+            if rec is None:
+                rec = self.board.epoch_record(r.epoch + 1)
+                if rec is None:
+                    break
+                self._epoch_recs[r.epoch + 1] = rec
+            self._adopt(r, rec)
+
+    def _adopt(self, r: SimRank, rec: dict) -> None:
+        new_members = tuple(int(m) for m in rec["members"])
+        old_epoch = r.epoch
+        # collector-side retirement of the old epoch's in-slots
+        in_srcs = [r.members[u] for u in r.graph.predecessors(
+            r.members.index(r.g))] if r.g in r.members else []
+        pend, _ = self.transport.retire_epoch(r.g, old_epoch, in_srcs)
+        led = self.transport.ledger(include={r.g})
+        self._journal(r.g, "epoch_switch", old_epoch=old_epoch,
+                      new_epoch=int(rec["epoch"]), global_rank=r.g,
+                      joined=list(rec.get("joined", ())),
+                      demoted=list(rec.get("demoted", ())),
+                      **{f"ledger_{k}": v for k, v in led.items()
+                         if k != "balanced"})
+        if r.g not in new_members:
+            # fenced: the fleet moved on without me (a zombie resumed
+            # past its own death declaration).  Exit without a
+            # snapshot — survivors adopted my ledger.
+            self.transport.adopted_ranks.add(r.g)
+            self.transport.lost_x += r.x
+            self.transport.lost_p += r.p
+            r.x = 0.0
+            r.p = 0.0
+            r.exited = True
+            self._log("fenced", r.g, epoch=int(rec["epoch"]))
+            self._check("fenced", r.g)
+            return
+        ekey = ("rec", int(rec["epoch"]))
+        G = self._topo_entry(ekey, lambda: record_graph(rec))
+        r.epoch = int(rec["epoch"])
+        r.epoch_members = r.members = new_members
+        r.graph = G
+        r.cfg_key = ekey
+        if rec.get("reweight"):
+            r.demoted = {int(d) for d in rec.get("demoted", ())}
+            bkey = ("recbase", int(rec["epoch"]))
+            r.base_key = bkey
+            if bkey not in self._topo_cache:
+                B = nx.DiGraph()
+                B.add_nodes_from(range(len(new_members)))
+                B.add_edges_from((int(u), int(v))
+                                 for u, v in rec["base_edges"])
+                from bluefog_tpu import topology_util as tu
+
+                tu.MetropolisHastingsWeights(B)
+                self._topo_cache[bkey] = B
+        else:
+            r.demoted = set()
+            r.base_key = ekey
+        for d in rec.get("promoted", ()):
+            r.health.absolve(int(d))
+        changed = set(rec.get("demoted", ())) | set(rec.get("promoted", ()))
+        if changed and r.policy is not None:
+            r.policy.note_epoch_change(changed)
+        # fresh detector over the new epoch's member view (the real
+        # switch restarts it over the new job namespace)
+        self._wire_rank(r)
+        # known dead stay dead only if still relevant; new epochs never
+        # include a declared corpse granted by a healed sponsor
+        r.known_dead &= set(new_members)
+        r.edge_seen = {}
+        self._log("epoch_switch", r.g, epoch=r.epoch,
+                  members=len(new_members),
+                  reweight=bool(rec.get("reweight")))
+        self._check("epoch_switch", r.g, graph=G,
+                    demoted=r.demoted, members=new_members)
+
+    def _grant(self, r: SimRank) -> None:
+        # the grown view must not include a corpse (mirror
+        # islands.admit_pending's pre-grant heal)
+        dead_local = r.detector.dead_ranks()
+        new_dead = {r.epoch_members[d] for d in dead_local} - r.known_dead
+        if new_dead:
+            self._heal(r, new_dead)
+        live = r.live_members()
+        if r.g != min(live):
+            return
+        Gg = nx.relabel_nodes(r.graph,
+                              {l: g for l, g in enumerate(r.members)},
+                              copy=True)
+        rec = self.board.grant(r.g, live, Gg, [], True, r.epoch)
+        if rec is not None:
+            self._log("grant", r.g, epoch=int(rec["epoch"]),
+                      joined=list(rec["joined"]))
+            self._journal(r.g, "join_admitted",
+                          joined=list(rec["joined"]),
+                          epoch=int(rec["epoch"]), sponsor=r.g)
+            self._check("grant", r.g)
+
+    def _joiner_event(self, f: Fault):
+        def fire():
+            if self.loop.now >= self.end_time:
+                return
+            self.joiners_spawned += 1
+            req = self.board.post_request()
+            self._log("join_post", -1, req=req)
+            try:
+                grant = self.board.wait_for_grant(
+                    req, timeout=self.cfg.join_timeout_s)
+            except TimeoutError:
+                self._log("join_timeout", -1, req=req)
+                return
+            rec = grant.record
+            sponsor = self.ranks.get(int(rec["sponsor"]))
+            if sponsor is None or sponsor.killed:
+                alive = [m for m in rec["members"]
+                         if m in self.ranks
+                         and not self.ranks[m].killed]
+                sponsor = self.ranks[alive[0]] if alive else None
+            est = sponsor.estimate if sponsor is not None else 0.0
+            j = SimRank(grant.rank, x=est, p=1.0)
+            self.joined_x += j.x
+            self.joined_p += j.p
+            j.epoch = int(rec["epoch"])
+            j.epoch_members = j.members = tuple(
+                int(m) for m in rec["members"])
+            ekey = ("rec", j.epoch)
+            j.graph = self._topo_entry(ekey, lambda: record_graph(rec))
+            j.cfg_key = j.base_key = ekey
+            self.ranks[j.g] = j
+            self._wire_rank(j)
+            self._journal(j.g, "epoch_switch", old_epoch=None,
+                          new_epoch=j.epoch, global_rank=j.g,
+                          joined=list(rec.get("joined", ())),
+                          mass_admitted=j.x)
+            self._log("join_enter", j.g, epoch=j.epoch,
+                      sponsor=int(rec["sponsor"]))
+            off = (j.g * 37 % 101) / 101.0
+            self.loop.after(off * self.cfg.hb_interval,
+                            self._hb_event(j.g))
+            self.loop.after(off * self.cfg.round_period,
+                            self._round_event(j.g))
+            self._check("join", j.g)
+        return fire
+
+    # -- adaptive demote/promote ------------------------------------------
+
+    def _adaptive_step(self, r: SimRank) -> None:
+        if r.health is None or len(r.members) < 3:
+            return
+        live = set(r.live_members())
+        suspects = {s for s in r.health.suspects()
+                    if s in live and s not in r.demoted and s != r.g}
+        gated = sorted(
+            (s for s in suspects
+             if r.policy.epoch_floor_open(s) and r.policy.corroborated(s)),
+            key=lambda s: (-r.health.time_in_state(s), s))
+        cap = (len(live) - 1) // 2
+        if "cap_bypass" in self.cfg.debug_bugs:
+            cap = len(live)  # seeded bug: no minority cap
+        room = cap - len(r.demoted)
+        if gated and room > 0:
+            picks = set(gated[:room])
+            self._commit_reweight(r, r.demoted | picks, promoted=())
+            return
+        promo = [d for d in sorted(r.demoted)
+                 if d in live and r.health.state(d) == EDGE_ALIVE
+                 and self._is_anchor(r, d)
+                 and r.policy.epoch_floor_open(d)]
+        if promo:
+            self._commit_reweight(r, r.demoted - set(promo),
+                                  promoted=tuple(promo))
+
+    def _is_anchor(self, r: SimRank, straggler_g: int) -> bool:
+        if straggler_g not in r.members:
+            return False
+        sl = r.members.index(straggler_g)
+        nbrs = set(r.graph.predecessors(sl)) | set(r.graph.successors(sl))
+        nbrs.discard(sl)
+        return len(nbrs) == 1 and r.members.index(r.g) in nbrs
+
+    def _commit_reweight(self, r: SimRank, demote_set: Set[int],
+                         promoted: Tuple[int, ...]) -> None:
+        base_graph = self._graph_of(r.base_key)
+        demote_local = sorted(r.members.index(d) for d in demote_set
+                              if d in r.members)
+        key = ("demote", r.base_key, tuple(demote_local))
+        if demote_local:
+            plan = self._topo_entry(
+                key,
+                lambda: _healing.demote_topology(base_graph,
+                                                 demote_local))
+            edges = list(plan.topology.edges)
+        else:
+            plan = self._topo_entry(
+                ("restore", r.base_key),
+                lambda: _healing.heal_topology(base_graph, []))
+            edges = list(plan.topology.edges)
+        rec = self.board.commit_reweight(
+            r.g, r.epoch, list(r.members), edges, [], True,
+            sorted(demote_set), sorted(promoted),
+            list(base_graph.edges))
+        if rec is not None and rec.get("reweight") \
+                and int(rec["sponsor"]) == r.g \
+                and int(rec["epoch"]) == r.epoch + 1:
+            kind = "promote_commit" if promoted else "demote_commit"
+            self._log(kind, r.g, epoch=int(rec["epoch"]),
+                      demoted=sorted(demote_set),
+                      promoted=sorted(promoted))
+            self._check("reweight", r.g,
+                        commit_members=len(r.live_members()),
+                        commit_demoted=len(demote_set))
+
+    # -- gossip ------------------------------------------------------------
+
+    def _combine(self, r: SimRank) -> None:
+        if r.g not in r.members:
+            return
+        me = r.members.index(r.g)
+        now = self.loop.now
+        dl = r.policy.gap_deadline_s() if (
+            self.cfg.adaptive and r.policy is not None) else None
+        for u in sorted(r.graph.predecessors(me)):
+            src = r.members[u]
+            if src in r.known_dead:
+                continue
+            ver = self.transport.read_version(r.epoch, r.g, src)
+            seen = r.edge_seen.get(src)
+            if seen is None:
+                r.edge_seen[src] = [ver, now, False]
+            elif ver > seen[0]:
+                gap = now - seen[1]
+                if self.cfg.adaptive:
+                    clean = dl is None or gap <= dl
+                    r.policy.note_fresh(src, gap, clean=clean)
+                r.edge_seen[src] = [ver, now, False]
+            else:
+                age = now - seen[1]
+                if (self.cfg.adaptive and dl is not None and age > dl
+                        and not seen[2]):
+                    r.policy.note_stale(src, age)
+                    seen[2] = True
+            cx, cp, fresh = self.transport.collect(r.epoch, r.g, src)
+            if fresh:
+                if "mass_leak" in self.cfg.debug_bugs:
+                    cx *= 1.0 - 1e-3  # seeded bug: combine leaks mass
+                r.x += cx
+                r.p += cp
+
+    def _send(self, r: SimRank) -> None:
+        if r.g not in r.members:
+            return
+        me = r.members.index(r.g)
+        rows = self._rows_of(r.cfg_key, r.graph)
+        keep, out = rows[me]
+        if not out:
+            return
+        sent_x = 0.0
+        sent_p = 0.0
+        lo, hi = self.cfg.latency_s
+        for v, w in out:
+            dst = r.members[v]
+            if dst in r.known_dead:
+                # degraded send: the weight a dead neighbor would have
+                # received stays with the sender (mass-conserving)
+                continue
+            lat = self.rng.uniform(lo, hi)
+            mx = w * r.x
+            mp = w * r.p
+            sent_x += mx
+            sent_p += mp
+            self.transport.deposit(r.epoch, r.g, dst, mx, mp, lat)
+        r.x -= sent_x
+        r.p -= sent_p
+
+    # -- invariants, logging, results -------------------------------------
+
+    def _log(self, kind: str, g: int, **aux) -> None:
+        t = round(self.loop.now, 9)
+        items = tuple(sorted(aux.items()))
+        self.event_log.append((t, kind, int(g), items))
+
+    def _violate(self, name: str, detail: str, g: int = -1) -> None:
+        v = {"t": round(self.loop.now, 9), "name": name,
+             "detail": detail, "rank": int(g)}
+        self.violations.append(v)
+        self._log("violation", g, name=name)
+        if len(self.violations) >= 50:
+            # runaway guard: a broken invariant fires on every
+            # subsequent event; 50 samples are plenty for the shrinker
+            self._faults.clear()
+
+    def _check(self, point: str, g: int, graph: Optional[nx.DiGraph] = None,
+               demoted: Optional[Set[int]] = None,
+               members: Optional[Tuple[int, ...]] = None,
+               commit_members: Optional[int] = None,
+               commit_demoted: Optional[int] = None) -> None:
+        """The standing invariants, audited after every protocol
+        event (see module docstring)."""
+        err = _inv.check_mass_conservation(
+            live_x=math.fsum(r.x for r in self.ranks.values()
+                             if not r.killed and not r.exited),
+            live_p=math.fsum(r.p for r in self.ranks.values()
+                             if not r.killed and not r.exited),
+            transport=self.transport,
+            initial=(self.initial_x, self.initial_p),
+            joined=(self.joined_x, self.joined_p),
+            tol=self.cfg.mass_tol)
+        if err:
+            self._violate("mass-conservation", f"at {point}: {err}", g)
+        word = self.transport.epoch_word
+        err = _inv.check_epoch_monotone(self._epoch_word_seen, word)
+        if err:
+            self._violate("epoch-monotone", f"at {point}: {err}", g)
+        self._epoch_word_seen = max(self._epoch_word_seen, word)
+        if graph is not None and id(graph) not in self._graphs_ok:
+            err = _inv.check_doubly_stochastic(graph)
+            if err:
+                self._violate("doubly-stochastic",
+                              f"at {point}: {err}", g)
+            else:
+                # memoized plan graphs are shared fleet-wide; verify
+                # each object once (the dict keeps it alive so the id
+                # can't be recycled)
+                self._graphs_ok[id(graph)] = graph
+        if demoted is not None and members is not None:
+            err = _inv.check_minority_demotion(len(members), len(demoted))
+            if err:
+                self._violate("minority-demotion",
+                              f"adopted at {point}: {err}", g)
+        if commit_members is not None and commit_demoted is not None:
+            err = _inv.check_minority_demotion(commit_members,
+                                               commit_demoted)
+            if err:
+                self._violate("minority-demotion",
+                              f"committed at {point}: {err}", g)
+
+    def run(self) -> None:
+        self.loop.run(max_events=self.cfg.max_events)
+
+    def finalize(self) -> dict:
+        """Quiesce-time settlement + the final invariant audit."""
+        # fence zombies that never noticed (suspended past the end)
+        for g, r in sorted(self.ranks.items()):
+            if not r.killed and not r.exited and r.g not in self._members_now():
+                self.transport.adopted_ranks.add(g)
+                self.transport.lost_x += r.x
+                self.transport.lost_p += r.p
+                r.x = 0.0
+                r.p = 0.0
+                r.exited = True
+                self._log("fenced", g, at="finalize")
+        # shutdown-style board sync: a rank that finished its rounds
+        # early stops probing, but stragglers may have committed
+        # later epochs behind its back (demote/promote churn) — adopt
+        # them now so the pending probe runs against the slots peers
+        # actually deposited into (the real shutdown barrier does the
+        # same final sync before settling)
+        for g, r in sorted(self.ranks.items()):
+            if not r.killed and not r.exited:
+                self._probe_epochs(r)
+        members = self._members_now()
+        for g in members:
+            r = self.ranks[g]
+            me = r.members.index(r.g)
+            in_srcs = [r.members[u] for u in r.graph.predecessors(me)]
+            self.transport.probe_pending(g, r.epoch, in_srcs)
+        self._check("finalize", -1)
+        ledger = self.transport.ledger()
+        if not ledger["balanced"]:
+            self._violate(
+                "ledger-balance",
+                f"deposits {ledger['deposits']} != collected "
+                f"{ledger['collected']} + drained {ledger['drained']} "
+                f"+ pending {ledger['pending']}")
+        ests = {g: self.ranks[g].estimate for g in members}
+        err = _inv.check_consensus(ests, tol=self.cfg.consensus_tol,
+                                   scale=max(1.0, abs(self.initial_x)
+                                             / max(1, self.initial_p)))
+        if err:
+            self._violate("consensus", err)
+        if self.cfg.journal_dir:
+            self._write_snapshots(members)
+        epoch = max((self.ranks[g].epoch for g in members), default=0)
+        return {"members": sorted(members), "epoch": epoch,
+                "ledger": ledger, "estimates": ests}
+
+    def _members_now(self) -> Set[int]:
+        alive = [r for _, r in sorted(self.ranks.items())
+                 if not r.killed and not r.exited]
+        if not alive:
+            return set()
+        top = max(alive, key=lambda r: (r.epoch, -r.g))
+        view = set(top.members) - self.transport.adopted_ranks \
+            - self.transport.killed
+        return {g for g in view
+                if g in self.ranks and not self.ranks[g].killed
+                and not self.ranks[g].exited}
+
+    def _write_snapshots(self, members: Set[int]) -> None:
+        from bluefog_tpu.telemetry import registry as _treg
+
+        t = self.transport
+        for g in sorted(members):
+            reg = self._mk_registry(g)
+            if reg is None or not reg.enabled:
+                continue
+            reg.counter(_treg.LEDGER_DEPOSITS).add(t.deposits.get(g, 0))
+            reg.counter(_treg.LEDGER_COLLECTED).add(t.collected.get(g, 0))
+            reg.counter(_treg.LEDGER_DRAINED).add(t.drained.get(g, 0))
+            reg.counter(_treg.LEDGER_PENDING).add(t.pending.get(g, 0))
+            reg.write_snapshot()

@@ -1,0 +1,146 @@
+"""The standing invariants the fleet simulator audits continuously.
+
+These are the properties the resilience design ARGUES hold at every
+point of every fault interleaving (docs/RESILIENCE.md); the simulator
+turns the argument into a check that runs after every protocol event
+of a campaign.  Each checker returns ``None`` when the invariant
+holds, or a human-readable violation string — the campaign layer
+records, never raises, so one violation cannot mask later ones and
+the shrinker can count them.
+
+The numeric core of the doubly-stochastic check
+(:func:`stochastic_violations`) is shared with the static analysis
+plane — ``analysis.plan_rules.check_mixing_stochastic`` wraps the same
+function over compiled plans, so the property audited offline on a
+plan and online on a campaign's healed/demoted/grown graphs is
+literally the same code.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "stochastic_violations",
+    "check_doubly_stochastic",
+    "check_mass_conservation",
+    "check_epoch_monotone",
+    "check_minority_demotion",
+    "check_consensus",
+    "demotion_cap",
+]
+
+#: float-epsilon tolerance for stochasticity sums (matches the
+#: analysis plan rules)
+STOCHASTIC_TOL = 1e-6
+
+
+def stochastic_violations(W: np.ndarray, expect_column: bool = True,
+                          tol: float = STOCHASTIC_TOL) -> List[str]:
+    """Row/column/negativity violations of a mixing matrix, as message
+    strings (empty list = doubly stochastic within ``tol``)."""
+    out: List[str] = []
+    rows = W.sum(axis=1)
+    bad_rows = np.flatnonzero(np.abs(rows - 1.0) > tol)
+    if bad_rows.size:
+        out.append(
+            f"row(s) {bad_rows[:6].tolist()} sum to "
+            f"{rows[bad_rows[:6]].tolist()} (expected 1±{tol}) — gossip "
+            "would not converge to a consensus")
+    if expect_column:
+        cols = W.sum(axis=0)
+        bad_cols = np.flatnonzero(np.abs(cols - 1.0) > tol)
+        if bad_cols.size:
+            out.append(
+                f"column(s) {bad_cols[:6].tolist()} sum to "
+                f"{cols[bad_cols[:6]].tolist()} (expected 1±{tol}) — the "
+                "fixed point drifts away from the true average")
+    if (W < -tol).any():
+        neg = np.argwhere(W < -tol)[:6].tolist()
+        out.append(f"negative mixing weight(s) at {neg}")
+    return out
+
+
+def check_doubly_stochastic(G, tol: float = STOCHASTIC_TOL
+                            ) -> Optional[str]:
+    """Every healed/demoted/grown topology a campaign installs must
+    carry a doubly stochastic W (the property that makes push-sum
+    converge to the true average on the member set)."""
+    from bluefog_tpu import topology_util
+
+    W = topology_util.GetWeightMatrix(G)
+    bad = stochastic_violations(np.asarray(W), expect_column=True,
+                                tol=tol)
+    return None if not bad else "; ".join(bad)
+
+
+def check_mass_conservation(live_x: float, live_p: float, transport,
+                            initial: Tuple[float, float],
+                            joined: Tuple[float, float],
+                            tol: float = 1e-8) -> Optional[str]:
+    """Every unit of push-sum mass lives in exactly one bucket —
+    ``live + slots + inflight + lost == initial + joined`` — after
+    EVERY event (transfers are intra-event).  ``tol`` is absolute on
+    the relative-to-scale residual."""
+    sx, sp = transport.slot_mass()
+    ix, ip = transport.inflight_mass()
+    want_x = initial[0] + joined[0]
+    want_p = initial[1] + joined[1]
+    have_x = live_x + sx + ix + transport.lost_x
+    have_p = live_p + sp + ip + transport.lost_p
+    scale_x = max(1.0, abs(want_x))
+    scale_p = max(1.0, abs(want_p))
+    dx = abs(have_x - want_x) / scale_x
+    dp = abs(have_p - want_p) / scale_p
+    if dx > tol or dp > tol:
+        return (f"mass off balance: x residual {have_x - want_x:.3e} "
+                f"(live {live_x:.6g} + slots {sx:.6g} + inflight "
+                f"{ix:.6g} + lost {transport.lost_x:.6g} != initial "
+                f"{initial[0]:.6g} + joined {joined[0]:.6g}), p residual "
+                f"{have_p - want_p:.3e}")
+    return None
+
+
+def check_epoch_monotone(prev: int, cur: int) -> Optional[str]:
+    """The membership-epoch word only ever moves forward (a backward
+    word would re-admit a retired epoch's mailboxes)."""
+    if cur < prev:
+        return (f"membership epoch word went backward: {prev} -> {cur}")
+    return None
+
+
+def demotion_cap(n_members: int) -> int:
+    """The adaptive-topology minority cap: strictly fewer than half of
+    the members may be demoted (``(n-1)//2``) — the healthy majority
+    must keep carrying the gossip."""
+    return max(0, (int(n_members) - 1) // 2)
+
+
+def check_minority_demotion(n_members: int,
+                            n_demoted: int) -> Optional[str]:
+    if n_demoted > demotion_cap(n_members):
+        return (f"{n_demoted} of {n_members} members demoted — over the "
+                f"minority cap {demotion_cap(n_members)} (the healthy "
+                "majority must keep carrying the gossip)")
+    return None
+
+
+def check_consensus(estimates: Dict[int, float], tol: float = 1e-6,
+                    scale: float = 1.0) -> Optional[str]:
+    """At quiesce every live rank's debiased estimate ``x/p`` must
+    agree (push-sum consensus).  ``scale`` normalizes the spread (the
+    caller passes the magnitude of the true average)."""
+    if len(estimates) < 2:
+        return None
+    vals = [estimates[g] for g in sorted(estimates)]
+    lo, hi = min(vals), max(vals)
+    spread = (hi - lo) / max(1.0, abs(scale))
+    if spread > tol:
+        glo = min(estimates, key=lambda g: estimates[g])
+        ghi = max(estimates, key=lambda g: estimates[g])
+        return (f"no consensus at quiesce: spread {spread:.3e} > {tol:g} "
+                f"(rank {glo} at {estimates[glo]:.9g}, rank {ghi} at "
+                f"{estimates[ghi]:.9g})")
+    return None

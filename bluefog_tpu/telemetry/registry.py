@@ -514,6 +514,9 @@ class NullRegistry:
     def close(self):
         pass
 
+    def close(self):
+        pass
+
 
 _NULL_REGISTRY = NullRegistry()
 _global: Optional[Registry] = None
@@ -541,7 +544,12 @@ def get_registry():
         return reg
     d = telemetry_dir()
     if d is None:
-        return _NULL_REGISTRY
+        # cache the off verdict too — hot paths (detector sweeps) call
+        # this per poll, and the env lookup dominates when disabled
+        with _global_lock:
+            if _global is None:
+                _global = _NULL_REGISTRY
+            return _global
     with _global_lock:
         if _global is None:
             _global = Registry(out_dir=d)

@@ -578,7 +578,7 @@ def test_tcp_join_rank_and_epoch_ops():
 
 
 def _worker_admit_after_kill(rank, size):
-    """np=4 exp2 gossip; chaos SIGKILLs one rank; survivors heal to 3,
+    """exp2 gossip; chaos SIGKILLs one rank; the survivors heal,
     then admit a replacement joiner and gossip on the grown membership.
     Returns (pre-join consensus, switch-point ledger totals, post-join
     state)."""
@@ -643,18 +643,26 @@ def _proc_joiner_after_kill(job, q):
 
 
 @pytest.mark.slow
-def test_kill_heal_join_restores_np4_consensus(monkeypatch):
-    """The elastic acceptance e2e: np=4 over exp2, one rank SIGKILLed
-    mid-gossip; survivors heal to 3 and reach consensus; a replacement
-    process joins (fresh global rank 4 — never the corpse's), every
-    member switches to epoch 1, and the grown 4-member job converges to
+def test_kill_heal_join_smoke(monkeypatch):
+    """The elastic wall-clock SMOKE: np=3 over exp2, one rank SIGKILLed
+    mid-gossip; survivors heal to 2 and reach consensus; a replacement
+    process joins (fresh global rank 3 — never the corpse's), every
+    member switches to epoch 1, and the grown 3-member job converges to
     the SAME value the survivors had agreed on: admission neither
     created nor destroyed mass.  The switch-point mass ledger balances
     globally (deposits == collected + drained + pending summed across
-    members)."""
+    members).
+
+    This is deliberately the SMALLEST fleet that exercises kill + heal
+    + join end to end over real processes and real shared memory (4
+    processes total; np=4 needed 5 and flaked under 1-core CI
+    contention).  The CANONICAL elastic scenario — same kill/join
+    choreography, every invariant checked after every event, and
+    bit-reproducible — is the deterministic port at
+    tests/test_sim.py::test_kill_heal_join_sim_canonical."""
     import multiprocessing as mp
 
-    size, victim = 4, 1
+    size, victim = 3, 1
     job = f"elastic{os.getpid()}"
     monkeypatch.setenv("BFTPU_FAILURE_TIMEOUT_S", "1.0")
     monkeypatch.setenv("BFTPU_TELEMETRY", "1")
@@ -681,12 +689,12 @@ def test_kill_heal_join_restores_np4_consensus(monkeypatch):
         grank, epoch, members, pre, ledger, post = res[r]
         assert grank == r          # stable global identity
         assert epoch == 1
-        assert members == (0, 2, 3, 4)  # corpse excised, fresh rank 4
+        assert members == (0, 2, 3)  # corpse excised, fresh rank 3
         pres.append(pre)
         ledgers.append(ledger)
         posts.append(post)
-    assert (jrank, jepoch) == (4, 1)
-    assert jmembers == (0, 2, 3, 4)
+    assert (jrank, jepoch) == (3, 1)
+    assert jmembers == (0, 2, 3)
     # survivors had reached consensus before the join
     pre_flat = np.stack(pres)
     assert float(pre_flat.max() - pre_flat.min()) < 1.0, pre_flat
@@ -694,7 +702,7 @@ def test_kill_heal_join_restores_np4_consensus(monkeypatch):
     # the joiner entered AT that consensus (sponsor's debiased estimate)
     assert np.allclose(jentry, pre_consensus, atol=1.0), (
         jentry, pre_consensus)
-    # post-join: all four agree, at the SAME value — the join moved no mass
+    # post-join: all three agree, at the SAME value — the join moved no mass
     all_post = np.stack(posts + [jout])
     assert float(all_post.max() - all_post.min()) < 1.0, all_post
     assert abs(float(all_post.mean()) - pre_consensus) < 1.0
