@@ -484,6 +484,21 @@ def main():
             print(json.dumps(spg), file=sys.stderr)
         except Exception as e:  # noqa: BLE001
             print(f"statuspage overhead phase failed: {e!r}", file=sys.stderr)
+    lab = None
+    if time.perf_counter() - t_start < budget_s:
+        try:
+            # convergence-probe overhead gate (docs/OBSERVABILITY.md
+            # "Convergence observatory"): the per-round debiased
+            # consensus-error subsample + status-page conv fields must
+            # stay < 2% of a gossip round — measured on the
+            # single-process self-edge loop (the protocol-ceiling
+            # precedent: a second process on this box measures the
+            # scheduler, not the probe)
+            from gossip_bandwidth import measure_lab_probe_overhead
+            lab = measure_lab_probe_overhead()
+            print(json.dumps(lab), file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"lab probe overhead phase failed: {e!r}", file=sys.stderr)
     rec = None
     if time.perf_counter() - t_start < budget_s:
         try:
@@ -559,7 +574,17 @@ def main():
         except Exception as e:  # noqa: BLE001
             print(f"wire compression phase failed: {e!r}", file=sys.stderr)
 
+    # which code produced which number (shared stamp with the lab sweep
+    # artifacts: git sha + date + host, sha suffixed "+dirty" when the
+    # tree doesn't match the commit)
+    try:
+        from bluefog_tpu.lab.sweep import provenance
+        prov = provenance()
+    except Exception:  # noqa: BLE001 — the stamp must never cost the run
+        prov = None
     headline = {
+        "schema": "bftpu-bench/1",
+        "provenance": prov,
         "metric": "ResNet-50 images/sec/chip (neighbor_allreduce exp2)"
         if on_tpu
         else "ResNet-18-tiny images/sec/chip (neighbor_allreduce exp2, CPU)",
@@ -622,6 +647,9 @@ def main():
     if spg is not None:
         headline["statuspage_overhead_pct"] = spg["value"]
         headline["statuspage_overhead_metric"] = spg["metric"]
+    if lab is not None:
+        headline["lab_probe_overhead_pct"] = lab["value"]
+        headline["lab_probe_overhead_metric"] = lab["metric"]
     if rec is not None:
         headline["recovery_ms"] = rec["value"]
         headline["recovery_metric"] = rec["metric"]

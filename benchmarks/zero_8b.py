@@ -36,7 +36,11 @@ import jax
 
 if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-jax.config.update("jax_compilation_cache_dir", "/tmp/bluefog_jax_cache")
+# JAX_COMPILATION_CACHE_DIR="" opts out: memory_analysis() on a
+# cache-deserialized executable reports alias_size_in_bytes == 0, so the
+# memory-contract tests need --compile to run against a fresh build.
+_cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/bluefog_jax_cache")
+jax.config.update("jax_compilation_cache_dir", _cache_dir or None)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 import jax.numpy as jnp

@@ -50,6 +50,11 @@ consumers (CLI, pytest, CI):
   machines finish clean (mass conserved, ledger balanced, consensus at
   quiesce), the same seed replays bit-identically, and a seeded
   invariant bug shrinks to its minimal schedule;
+- **lab** (:mod:`.lab_rules`) — the convergence observatory's frozen
+  sweep artifact: schema-valid, cell fits refittable from their own
+  series, scaling laws non-increasing in fleet size, measured rates
+  rank-correlated with spectral gaps, every cell sim-oracle clean,
+  and the stored recommendation map consistent with recomputation;
 - the **fixture corpus** (:mod:`.fixtures`) — seeded bugs proving every
   rule fires.
 
@@ -76,6 +81,7 @@ from bluefog_tpu.analysis import (  # noqa: F401
     hlo_corpus,
     hlo_rules,
     introspect_rules,
+    lab_rules,
     plan_rules,
     progress_rules,
     resilience_rules,

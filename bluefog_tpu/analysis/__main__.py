@@ -106,9 +106,38 @@ def main(argv=None) -> int:
             print(f"self-test FAILED: campaign(s) violated invariants "
                   f"{dirty}")
             return 1
+        # lab arm: every claim the frozen sweep artifact makes must
+        # re-derive from its own raw data (python -m bluefog_tpu.lab
+        # --check runs the same checks standalone)
+        from bluefog_tpu.analysis.engine import Severity
+        from bluefog_tpu.analysis.lab_rules import check_artifact
+        from bluefog_tpu.lab.recommend import (default_artifact_path,
+                                               load_artifact)
+
+        try:
+            art = load_artifact()
+        except (OSError, ValueError) as e:
+            print(f"self-test FAILED: frozen lab artifact unreadable "
+                  f"({default_artifact_path()}): {e}")
+            return 1
+        lab_findings = check_artifact(
+            art, label="LAB_" + str(art.get("version")))
+        lab_errors = [f for f in lab_findings
+                      if f.severity == Severity.ERROR]
+        ncells = len(art.get("cells") or ())
+        print(f"  {'lab artifact LAB_' + str(art.get('version')):<36s} "
+              f"{'clean' if not lab_errors else 'VIOLATED'} "
+              f"(cells={ncells}, "
+              f"spearman={art.get('spearman_rate_vs_gap'):.3f})")
+        for f in lab_errors:
+            print(f"    {f}")
+        if lab_errors:
+            print("self-test FAILED: frozen lab artifact fails its own "
+                  "checks")
+            return 1
         print(f"self-test OK: all {len(fixtures.FIXTURES)} seeded bugs "
               f"caught, {len(sim_rules.SELFTEST_PINS)} pinned campaigns "
-              "clean")
+              f"clean, lab artifact verified ({ncells} cells)")
         return 0
 
     families = args.families

@@ -560,6 +560,63 @@ def _sim_cap_bypass() -> List[Finding]:
     return sim_rules.campaign_findings(res, "fixture[sim-cap-bypass]")
 
 
+# ---------------------------------------------------------------------------
+# lab fixtures: mutate the REAL frozen sweep artifact (same rationale as
+# the plan fixtures — a schema change that disarms a rule breaks these)
+# ---------------------------------------------------------------------------
+
+
+def _lab_artifact() -> dict:
+    import copy
+
+    from bluefog_tpu.lab.recommend import load_artifact
+
+    return copy.deepcopy(load_artifact())
+
+
+def _lab_corrupted_fit() -> List[Finding]:
+    """A scaling law whose exponent was clobbered to claim contraction
+    rates GROWING with fleet size — physically impossible for every
+    corpus topology (gaps are non-increasing in n) and no longer the
+    law the measured cells refit to."""
+    from bluefog_tpu.analysis import lab_rules
+
+    art = _lab_artifact()
+    topo = sorted(art["fits"])[0]
+    art["fits"][topo]["b"] = 0.5  # rates grow ~ n^0.5: impossible
+    return lab_rules.check_fit_monotonicity(
+        art, f"LAB[{topo}-growing-law]")
+
+
+def _lab_tampered_rate() -> List[Finding]:
+    """A cell's headline rate hand-edited away from what its own stored
+    series refits to — the tampered-number signature the raw-data-in-
+    artifact design exists to catch."""
+    from bluefog_tpu.analysis import lab_rules
+
+    art = _lab_artifact()
+    cell = art["cells"][0]
+    cell["rate"] = min(1.0, float(cell["rate"]) * 0.5 + 0.25)
+    return lab_rules.check_cell_refit(art, "LAB[tampered-rate]")
+
+
+def _lab_recommendation_contradicts_corpus() -> List[Finding]:
+    """A stored recommendation swapped to a topology the measured
+    corpus does not pick — recomputing ``lab.recommend`` over the same
+    artifact must contradict it (the determinism contract behind
+    BFTPU_LAB_AUTO_TOPOLOGY)."""
+    from bluefog_tpu.analysis import lab_rules
+    from bluefog_tpu.lab.recommend import TOPOLOGIES
+
+    art = _lab_artifact()
+    key = sorted(art["recommended"])[0]
+    stored = art["recommended"][key]
+    stored["topology"] = next(t for t in sorted(TOPOLOGIES)
+                              if t != stored["topology"])
+    return lab_rules.check_recommendation_consistency(
+        art, "LAB[swapped-recommendation]")
+
+
 FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
     # plan family
     "plan-duplicate-destination": _plan_duplicate_destination,
@@ -634,6 +691,11 @@ FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
     # sim family: seeded invariant bugs a full campaign must catch
     "sim-mass-leak": _sim_mass_leak,
     "sim-cap-bypass": _sim_cap_bypass,
+    # lab family: tampered sweep artifacts the observatory must reject
+    "lab-corrupted-fit": _lab_corrupted_fit,
+    "lab-tampered-rate": _lab_tampered_rate,
+    "lab-recommendation-contradicts-corpus":
+        _lab_recommendation_contradicts_corpus,
     # trace family: crossed spans, corrupted flow identity, clock skew
     "trace-unbalanced-nesting": _trace_unbalanced_nesting,
     "trace-dangling-flow": _trace_dangling_flow,

@@ -91,8 +91,8 @@ def render(snap: dict, rates: Dict[int, float]) -> str:
                      f"pending_scale={la.get('pending_scale', 0)}")
     lines.append("")
     lines.append(f"{'RANK':>4} {'STEP':>8} {'STEP/S':>7} {'EPOCH':>5} "
-                 f"{'LAST OP':<12} {'BALANCE':>10} {'QUEUE':<14} "
-                 f"{'HOLDS':<8} EDGES")
+                 f"{'LAST OP':<12} {'BALANCE':>10} {'CONV':>9} "
+                 f"{'QUEUE':<14} {'HOLDS':<8} EDGES")
     for r in ranks:
         page = snap["ranks"][str(r)]
         if "error" in page:
@@ -110,12 +110,17 @@ def render(snap: dict, rates: Dict[int, float]) -> str:
         queue = "-" if qd < 0 else (
             f"{qd}" + (f">{prog['inflight']}" if prog.get("inflight")
                        else ""))
+        # convergence probe (statuspage v3): debiased consensus-error
+        # sample; "—" = probe off (or pre-v3 writer / first round)
+        conv = page.get("conv", {})
+        cerr, cround = conv.get("err", -1.0), conv.get("round", -1)
+        conv_s = f"{cerr:.1e}" if cround >= 0 and cerr >= 0.0 else "—"
         lines.append(
             f"{r:>4} {page['step']:>8} "
             f"{('%.1f' % rate) if rate is not None else '—':>7} "
             f"{page['epoch']:>5} {page['last_op']:<12} "
-            f"{page['ledger']['balance']:>10.3g} {queue:<14} "
-            f"{holds:<8} {edges}")
+            f"{page['ledger']['balance']:>10.3g} {conv_s:>9} "
+            f"{queue:<14} {holds:<8} {edges}")
     if snap.get("suspects"):
         lines.append("")
         lines.append(f"straggler suspects: "

@@ -21,6 +21,7 @@ the membership-epoch word — that lets ``bftpu-top trace on|off`` flip
 from __future__ import annotations
 
 import glob
+import math
 import os
 import struct
 import time
@@ -28,21 +29,25 @@ from typing import Dict, List, Optional, Tuple
 
 from bluefog_tpu.native import shm_native
 
-STATUS_SCHEMA = "bftpu-statuspage/2"
+STATUS_SCHEMA = "bftpu-statuspage/3"
 STATUS_MAGIC = 0x42465350  # "BFSP"
-STATUS_VERSION = 2
+STATUS_VERSION = 3
 
 #: Page layout: header (magic u32, version u32, seq u64), fixed block,
 #: then up to MAX_EDGES edge records; the whole page is padded to
 #: PAGE_BYTES so the file size is stable across republishes.
 #: v2 appends the progress-engine view (queue depth + in-flight op) to
-#: the fixed block; readers still decode v1 pages from live v1 writers.
+#: the fixed block; v3 appends the convergence-probe word (consensus
+#: error + probe round).  Readers still decode v1/v2 pages from live
+#: older writers.
 _HEAD = struct.Struct("<IIQ")                 # magic, version, seq
 _FIXED_V1 = struct.Struct("<iiiiQQQdd16sdddd")  # rank, nranks, pid, n_edges,
 #                                                 step, epoch, op_id,
 #                                                 wall_ts, mono_ts, last_op,
 #                                                 ledger dep/col/drn/pend
-_FIXED = struct.Struct("<iiiiQQQdd16sddddi16s")  # ... + qdepth, inflight
+_FIXED_V2 = struct.Struct("<iiiiQQQdd16sddddi16s")  # ... + qdepth, inflight
+_FIXED = struct.Struct("<iiiiQQQdd16sddddi16sdq")   # ... + conv_err,
+#                                                       conv_round
 _EDGE = struct.Struct("<iid")                 # peer_global, state, deadline_s
 MAX_EDGES = 32
 PAGE_BYTES = 1024
@@ -82,14 +87,16 @@ class StatusPage:
 
     def publish(self, *, nranks: int, step: int, epoch: int, op_id: int,
                 last_op: str = "", ledger: Optional[Dict[str, float]] = None,
-                edges=(), qdepth: int = -1, inflight: str = "") -> None:
+                edges=(), qdepth: int = -1, inflight: str = "",
+                conv_err: float = -1.0, conv_round: int = -1) -> None:
         """Seqlocked single-writer update of the whole page.
 
         ``edges`` is an iterable of ``(peer_global, state_code,
         deadline_s)`` tuples (truncated at MAX_EDGES); ``ledger`` maps
         the ``_LEDGER_KEYS`` to mass totals (missing keys read 0.0);
         ``qdepth``/``inflight`` mirror the rank's progress engine
-        (-1 = no engine running)."""
+        (-1 = no engine running); ``conv_err``/``conv_round`` mirror
+        the convergence probe (round -1 = probe off)."""
         mm = self._seg._mm
         led = ledger or {}
         ed = list(edges)[:MAX_EDGES]
@@ -106,7 +113,8 @@ class StatusPage:
             float(led.get("deposits", 0.0)), float(led.get("collected", 0.0)),
             float(led.get("drained", 0.0)), float(led.get("pending", 0.0)),
             int(qdepth),
-            str(inflight).encode("utf-8", "replace")[:16])
+            str(inflight).encode("utf-8", "replace")[:16],
+            float(conv_err), int(conv_round))
         off = _HEAD.size + _FIXED.size
         for peer, state, deadline in ed:
             _EDGE.pack_into(mm, off, int(peer), int(state), float(deadline))
@@ -122,7 +130,7 @@ def _decode(buf: bytes) -> Dict[str, object]:
     magic, version, seq = _HEAD.unpack_from(buf, 0)
     if magic != STATUS_MAGIC:
         raise ValueError(f"not a status page (magic 0x{magic:08x})")
-    if version not in (1, STATUS_VERSION):
+    if version not in (1, 2, STATUS_VERSION):
         raise ValueError(f"unsupported status-page version {version}")
     if version == 1:
         # a live v1 writer (mid-upgrade fleet): no progress-engine block
@@ -130,11 +138,19 @@ def _decode(buf: bytes) -> Dict[str, object]:
          last_op, dep, col, drn, pend) = _FIXED_V1.unpack_from(
             buf, _HEAD.size)
         qdepth, inflight = -1, b""
+        conv_err, conv_round = -1.0, -1
         fixed_size = _FIXED_V1.size
-    else:
+    elif version == 2:
+        # a live v2 writer: progress block, no convergence word
         (rank, nranks, pid, n_edges, step, epoch, op_id, wall_ts, mono_ts,
          last_op, dep, col, drn, pend, qdepth, inflight) = \
-            _FIXED.unpack_from(buf, _HEAD.size)
+            _FIXED_V2.unpack_from(buf, _HEAD.size)
+        conv_err, conv_round = -1.0, -1
+        fixed_size = _FIXED_V2.size
+    else:
+        (rank, nranks, pid, n_edges, step, epoch, op_id, wall_ts, mono_ts,
+         last_op, dep, col, drn, pend, qdepth, inflight,
+         conv_err, conv_round) = _FIXED.unpack_from(buf, _HEAD.size)
         fixed_size = _FIXED.size
     edges: List[Dict[str, object]] = []
     off = _HEAD.size + fixed_size
@@ -168,6 +184,16 @@ def _decode(buf: bytes) -> Dict[str, object]:
             "qdepth": int(qdepth),
             "inflight": inflight.split(b"\0", 1)[0].decode(
                 "utf-8", "replace"),
+        },
+        # the convergence probe's word (bluefog_tpu.lab): err is the
+        # debiased consensus-error sample at probe round `round`;
+        # round < 0 = probe off (or a pre-v3 writer), err NaN = the
+        # probe's first round (a difference needs a predecessor)
+        "conv": {
+            # non-finite (a NaN first-round sample) sanitized to -1.0 so
+            # collect()'s payload stays strict-JSON serializable
+            "err": float(conv_err) if math.isfinite(conv_err) else -1.0,
+            "round": int(conv_round),
         },
         "edges": edges,
     }

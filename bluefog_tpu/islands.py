@@ -186,8 +186,7 @@ class _IslandContext:
         self.rank = rank_
         self.size = size_
         self.job = job
-        self.topology: nx.DiGraph = topology_util.ExponentialTwoGraph(size_) \
-            if size_ > 1 else _trivial_graph()
+        self.topology: nx.DiGraph = _default_topology(size_)
         self.windows: Dict[str, _IslandWindow] = {}
         self.created_names: set = set()  # for shm unlink at shutdown
         self.win_fusion: Dict[str, object] = {}  # name -> pytree pack meta
@@ -227,6 +226,13 @@ class _IslandContext:
         self.statuspage = None
         self.tracectl = None
         self.op_rounds = 0
+        # convergence observatory (bluefog_tpu.lab): per-window probes,
+        # created lazily on the first win_update so the env decision is
+        # made after spawn() has propagated the lab env keys to workers.
+        # None = not yet checked, False = probe disabled, dict = live.
+        self.lab_probes = None
+        self.conv_err = -1.0
+        self.conv_round = -1
         # per-rank background progress engine (bluefog_tpu.progress),
         # created lazily on the first *_async call so synchronous
         # programs never pay for the worker thread
@@ -245,6 +251,31 @@ def _trivial_graph() -> nx.DiGraph:
     g = nx.DiGraph()
     g.add_node(0)
     return g
+
+
+def _default_topology(size_: int) -> nx.DiGraph:
+    """The launch topology for an island fleet of ``size_``.
+
+    Static default: exponential-2 (the paper's workhorse).  With
+    ``BFTPU_LAB_AUTO_TOPOLOGY=1`` the choice is delegated to the lab's
+    measured scaling laws (:func:`bluefog_tpu.lab.recommend`), sized by
+    ``BFTPU_LAB_PAYLOAD_BYTES``; any failure there (no artifact, bad
+    env) falls back to the static default — opting in to auto-topology
+    must never be able to fail init."""
+    if size_ <= 1:
+        return _trivial_graph()
+    if os.environ.get("BFTPU_LAB_AUTO_TOPOLOGY", "0").lower() in (
+            "1", "true", "yes", "on"):
+        try:
+            from bluefog_tpu import lab as _lab
+
+            payload = int(os.environ.get("BFTPU_LAB_PAYLOAD_BYTES",
+                                         "1048576"))
+            rec = _lab.recommend(size_, payload)
+            return _lab.build_topology(rec["topology"], size_)
+        except Exception:
+            pass
+    return topology_util.ExponentialTwoGraph(size_)
 
 
 def _attach_edge_health(ctx: "_IslandContext") -> None:
@@ -1181,6 +1212,14 @@ def win_free(name: Optional[str] = None) -> bool:
     names = [name] if name is not None else sorted(ctx.windows)
     ok = True
     reg = _telemetry.get_registry()
+    if ctx.lab_probes:
+        # flush + journal the convergence probe's batched tail before
+        # the window goes away
+        for n in names:
+            pr = ctx.lab_probes.get(n)
+            if pr is not None:
+                pr.flush_pending()
+                _drain_conv_journal(ctx, n, pr)
     eng = ctx.progress
     if eng is not None:
         # flush queued async ops into the still-live segments, then park
@@ -1308,6 +1347,66 @@ def _note_op(op: str, name: str) -> None:
     _telemetry.note_op(op, name)
 
 
+def _lab_probe_tick(ctx: "_IslandContext", win: "_IslandWindow",
+                    name: str) -> None:
+    """Feed this round's post-combine tensor to the window's convergence
+    probe (:mod:`bluefog_tpu.lab`) and stream the sample into telemetry.
+    Off-path: when ``BFTPU_LAB_PROBE`` is unset the per-op cost is one
+    attribute load and a falsy branch, same convention as tracing and
+    the status page.  The enablement check is lazy (first win_update,
+    not context init) so spawn() has already propagated the env.
+
+    The probe batches its math over ``BFTPU_LAB_FLUSH`` rounds (the
+    probe module's cost model: the tick runs cache-cold, so per-round
+    numpy has a ~40 µs floor the < 2% gate can't afford), so the page's
+    ``(conv_err, conv_round)`` pair and the journal trail advance in
+    flush-sized bursts — every round's exact value still lands, each
+    tagged with its own round index."""
+    probes = ctx.lab_probes
+    if probes is False:
+        return
+    if probes is None:
+        from bluefog_tpu.lab import probe as _lab_probe
+
+        if not _lab_probe.probe_enabled():
+            ctx.lab_probes = False
+            return
+        probes = ctx.lab_probes = {}
+    if name not in probes:
+        from bluefog_tpu.lab import probe as _lab_probe
+
+        probes[name] = _lab_probe.ConvergenceProbe(
+            flush_every=_lab_probe.flush_every_env())
+        probes[name]._journaled = 0  # history entries already journaled
+    pr = probes[name]
+    err = pr.observe(win.self_tensor,
+                     win.p_self if ctx.associated_p else 1.0)
+    if pr.last_round > 0:
+        ctx.conv_round = pr.last_round
+        ctx.conv_err = err if err == err else -1.0  # NaN first round
+    _drain_conv_journal(ctx, name, pr)
+
+
+def _drain_conv_journal(ctx: "_IslandContext", name: str, pr) -> None:
+    """Journal the probe's newly computed (round, err) history entries.
+    Called from the tick (after a flush lands a burst), from the
+    ``win_conv_*`` accessors, and from win_free — so the batched tail
+    (up to ``BFTPU_LAB_FLUSH - 1`` rounds) is never lost to the
+    journal."""
+    hist = pr.history
+    done = getattr(pr, "_journaled", 0)
+    if done >= len(hist):
+        return
+    reg = _telemetry.get_registry()
+    if reg.enabled:
+        for t, e in hist[done:]:
+            if e == e:  # the round-1 NaN has no predecessor
+                reg.gauge("lab.conv_err", win=name).set(e)
+                reg.journal("conv", win=name, round=t, err=e,
+                            epoch=ctx.epoch)
+    pr._journaled = len(hist)
+
+
 def _statuspage_tick(ctx: "_IslandContext", name: str,
                      op: str = "win_update") -> None:
     """Republish my live status page (one seqlocked mmap write, no
@@ -1343,7 +1442,8 @@ def _statuspage_tick(ctx: "_IslandContext", name: str,
         page.publish(nranks=len(ctx.members_global), step=ctx.op_rounds,
                      epoch=ctx.epoch, op_id=ctx.op_rounds,
                      last_op=f"{op}:{name}", ledger=ledger, edges=edges,
-                     qdepth=qdepth, inflight=inflight)
+                     qdepth=qdepth, inflight=inflight,
+                     conv_err=ctx.conv_err, conv_round=ctx.conv_round)
     except (OSError, ValueError):
         pass  # a reaped segment must never fail the op itself
     if ctx.tracectl is not None:
@@ -1887,6 +1987,7 @@ def win_update(
                 tr.end(ttok, consume=consumes)
                 tr.advance_round()
             _note_op("win_update", name)
+            _lab_probe_tick(ctx, win, name)
             _statuspage_tick(ctx, name)
             out = win.self_tensor
             out = np.array(out, copy=True) if clone else out
@@ -1939,6 +2040,7 @@ def win_update(
             tr.end(ttok, consume=consumes)
             tr.advance_round()
         _note_op("win_update", name)
+        _lab_probe_tick(ctx, win, name)
         _statuspage_tick(ctx, name)
         out = win.self_tensor
         out = np.array(out, copy=True) if clone else out
@@ -2050,6 +2152,38 @@ def win_set_exposed(name: str, tensor, associated_p: Optional[float] = None) -> 
     if associated_p is not None:
         win.p_self = float(associated_p)
     win.shm.expose(t, win.p_self)
+
+
+def win_conv_error(name: str) -> Tuple[int, float]:
+    """``(round, err)`` from the window's convergence probe
+    (:mod:`bluefog_tpu.lab`): the round counter and the latest debiased
+    consensus-error sample.  ``(-1, nan)`` when ``BFTPU_LAB_PROBE`` is
+    off or no win_update has run yet; ``err`` is NaN on the first
+    probed round (a successive difference needs a predecessor)."""
+    ctx = _ctx()
+    _win(name)  # raise KeyError on unknown windows, like the other accessors
+    probes = ctx.lab_probes
+    if not probes or name not in probes:
+        return (-1, float("nan"))
+    pr = probes[name]
+    pr.flush_pending()  # reads want the batched stragglers computed
+    _drain_conv_journal(ctx, name, pr)
+    return (pr.rounds, pr.last_err)
+
+
+def win_conv_history(name: str) -> List[Tuple[int, float]]:
+    """The window's full probe history, ``[(round, err), ...]`` oldest
+    first (empty when the probe is off) — what the lab sweep driver
+    fits a contraction rate to."""
+    ctx = _ctx()
+    _win(name)
+    probes = ctx.lab_probes
+    if not probes or name not in probes:
+        return []
+    pr = probes[name]
+    pr.flush_pending()
+    _drain_conv_journal(ctx, name, pr)
+    return list(pr.history)
 
 
 def get_win_version(name: str) -> Dict[int, int]:

@@ -83,6 +83,13 @@ _SIM_KEYS = ("BFTPU_SIM_SEED", "BFTPU_SIM_RANKS", "BFTPU_SIM_ROUNDS",
              "BFTPU_SIM_SCHEDULE", "BFTPU_SIM_QUIESCE_ROUNDS",
              "BFTPU_SIM_LATENCY_MS", "BFTPU_SIM_REPRO_DIR")
 
+# convergence-observatory knobs (bluefog_tpu.lab): a stale probe or
+# auto-topology flag leaking across tests changes the next fleet's hot
+# path (probe ticks) or its launch topology — schedule-grade state
+_LAB_KEYS = ("BFTPU_LAB_PROBE", "BFTPU_LAB_AUTO_TOPOLOGY",
+             "BFTPU_LAB_PAYLOAD_BYTES", "BFTPU_LAB_ARTIFACT",
+             "BFTPU_LAB_SAMPLE", "BFTPU_LAB_FLUSH")
+
 # injectable clock (sim/clock.py seam) for the delay/straggler sleeps;
 # process-level signals (suspend_self) always use wall time — you
 # cannot virtualize a SIGSTOP
@@ -214,9 +221,9 @@ def apply_schedule_json(payload: str, env: Optional[dict] = None) -> dict:
 def clear_schedule() -> None:
     """Scrub EVERY chaos key from the calling process's environment —
     kill, join, and suspend schedules alike (a stale key would replay
-    the fault in the next test's workers) — plus the sim-campaign
-    keys, which are schedules by another name."""
-    for k in _ALL_KEYS + _SIM_KEYS:
+    the fault in the next test's workers) — plus the sim-campaign and
+    lab keys, which are schedules by another name."""
+    for k in _ALL_KEYS + _SIM_KEYS + _LAB_KEYS:
         os.environ.pop(k, None)
 
 

@@ -70,6 +70,10 @@ def build_env(args, base_env=None) -> dict:
         # islands mode: straggler-aware gossip (resilience/adaptive.py);
         # plain env spelling BFTPU_ADAPTIVE=1 is forwarded anyway
         env["BFTPU_ADAPTIVE"] = "1"
+    if getattr(args, "lab_probe", False):
+        # islands mode: per-rank convergence probe (lab/probe.py); plain
+        # env spelling BFTPU_LAB_PROBE=1 is forwarded anyway
+        env["BFTPU_LAB_PROBE"] = "1"
     # Multi-host bootstrap: forwarded to jax.distributed.initialize via env
     # (JAX reads these standard variables).
     if args.coordinator:
@@ -649,6 +653,14 @@ def main(argv=None) -> int:
         "round and a persistently slow rank is demoted to one anchor "
         "edge instead of convoying the fleet (docs/RESILIENCE.md, "
         "'Adaptive topology')",
+    )
+    parser.add_argument(
+        "--lab-probe",
+        action="store_true",
+        help="islands mode: stream the per-rank convergence probe "
+        "(BFTPU_LAB_PROBE=1) — each win_update publishes the debiased "
+        "consensus error to telemetry and the status page's CONV "
+        "column (docs/OBSERVABILITY.md, 'Convergence observatory')",
     )
     parser.add_argument(
         "--attach",

@@ -35,7 +35,8 @@ from bluefog_tpu.sim.campaign import (
     load_repro)
 from bluefog_tpu.sim.schedule import FAULT_KINDS, FaultSchedule
 
-_TOPOLOGIES = ("exp2", "exp", "ring", "star", "full")
+_TOPOLOGIES = ("exp2", "exp", "sym_exp4", "ring", "ring_uni", "star",
+               "mesh2d", "full")
 
 
 def _env(key: str, default=None):

@@ -89,6 +89,17 @@ class SimConfig:
     max_events: int = 20_000_000
     journal_dir: Optional[str] = None
     debug_bugs: Tuple[str, ...] = ()
+    # convergence observatory (bluefog_tpu.lab): record per-rank
+    # successive-estimate differences each round.  The trace rides in
+    # CampaignResult, NOT the event log — digests (and every existing
+    # repro file) are unchanged whether it is on or off.
+    trace_consensus: bool = False
+    # lockstep=True drops the per-rank start stagger so every round
+    # fires at the same virtual instant; with deposit latency > 0 each
+    # round then collects exactly the previous round's deposits — the
+    # synchronous ``x ← Wx`` iterate a barriered real fleet runs, which
+    # is what makes the sim usable as the lab sweep's rate oracle.
+    lockstep: bool = False
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -120,6 +131,8 @@ class CampaignResult:
     schedule: FaultSchedule
     config: SimConfig
     event_log: List[tuple] = dataclasses.field(default_factory=list)
+    # (round, rank, err) samples when cfg.trace_consensus (lab oracle)
+    consensus_trace: List[tuple] = dataclasses.field(default_factory=list)
 
     def summary(self) -> dict:
         est = self.final.get("estimates", {})
@@ -165,6 +178,7 @@ def run_campaign(cfg: SimConfig,
         schedule=schedule,
         config=cfg,
         event_log=list(fleet.event_log),
+        consensus_trace=list(fleet.consensus_trace),
     )
 
 

@@ -118,6 +118,12 @@ class SimFleet:
         self.rng = random.Random(int(cfg.seed) ^ 0x5EED0F)
         self.event_log: List[tuple] = []
         self.violations: List[dict] = []
+        # lab oracle feed: (round, rank, |Δestimate|) per round when
+        # cfg.trace_consensus — same observable as the islands probe
+        # (bluefog_tpu.lab.probe), kept OUT of event_log so digests
+        # and repro files are byte-identical with tracing on or off
+        self.consensus_trace: List[tuple] = []
+        self._conv_prev: Dict[int, float] = {}
         self._epoch_word_seen = 0
         self._topo_cache: Dict[object, tuple] = {}
         # graphs already audited doubly stochastic (id -> graph ref)
@@ -163,8 +169,11 @@ class SimFleet:
         builders = {
             "exp2": tu.ExponentialTwoGraph,
             "exp": tu.ExponentialGraph,
+            "sym_exp4": tu.SymmetricExponentialGraph,
             "ring": tu.RingGraph,
+            "ring_uni": lambda n: tu.RingGraph(n, connect_style=1),
             "star": tu.StarGraph,
+            "mesh2d": tu.MeshGrid2DGraph,
             "full": tu.FullyConnectedGraph,
         }
         try:
@@ -218,9 +227,11 @@ class SimFleet:
         self.joined_p = 0.0
         self._rows_cache = {("epoch", 0): rows}
         # stagger starts so rounds interleave like free-running
-        # processes (deterministically)
+        # processes (deterministically); cfg.lockstep zeroes the
+        # stagger so the fleet iterates synchronously (lab oracle mode)
         for g in range(cfg.ranks):
-            off = (g * 37 % 101) / 101.0
+            off = 0.0 if getattr(cfg, "lockstep", False) \
+                else (g * 37 % 101) / 101.0
             self.loop.at(_T0 + off * cfg.hb_interval, self._hb_event(g))
             self.loop.at(_T0 + off * cfg.round_period,
                          self._round_event(g))
@@ -359,6 +370,15 @@ class SimFleet:
         self._combine(r)
         # 6. deposit this round's shares
         self._send(r)
+        # 6b. lab oracle: per-rank successive-estimate difference, the
+        # sim twin of the islands convergence probe
+        if getattr(self.cfg, "trace_consensus", False):
+            est = r.estimate
+            prev = self._conv_prev.get(r.g)
+            if prev is not None and est == est and prev == prev:
+                self.consensus_trace.append(
+                    (r.round_idx, r.g, abs(est - prev)))
+            self._conv_prev[r.g] = est
         # 7. continuous audit: the lowest live rank checks the global
         # mass balance once per round (every protocol event above
         # checked it already; this catches combine/send-path leaks)

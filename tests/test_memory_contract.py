@@ -42,6 +42,25 @@ GB = 1e9
 
 
 @pytest.fixture(autouse=True)
+def no_persistent_compile_cache():
+    # bench.py enables the persistent compilation cache at import time
+    # (tests/test_bench_estimator.py pulls it in), and executables
+    # deserialized from that cache report alias_size_in_bytes == 0 —
+    # every aliasing assertion below would fail in-suite while passing
+    # in isolation.  These contracts need a real compile.  Clearing the
+    # config alone is not enough: is_cache_used() memoizes its verdict
+    # per process, so once any compile ran with the cache on, the dir
+    # change is ignored until reset_cache() drops the memo.
+    from jax._src import compilation_cache as _cc
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    _cc.reset_cache()
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev)
+    _cc.reset_cache()
+
+
+@pytest.fixture(autouse=True)
 def fresh_context(devices):
     bf.init()
     bf.set_topology(tu.ExponentialTwoGraph(8))
@@ -247,6 +266,7 @@ def test_8b_adamw_full_compile_fits_16gb_at_2x16():
         PYTHONPATH=REPO,
         ZERO8B_MESH="2x16",
         XLA_FLAGS="--xla_force_host_platform_device_count=32",
+        JAX_COMPILATION_CACHE_DIR="",  # fresh compile: see no_persistent_compile_cache
     )
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "benchmarks", "zero_8b.py"),
@@ -271,6 +291,7 @@ def test_8b_full_compile_fits_16gb():
         PYTHONPATH=REPO,
         ZERO8B_MESH="4x8",
         XLA_FLAGS="--xla_force_host_platform_device_count=32",
+        JAX_COMPILATION_CACHE_DIR="",  # fresh compile: see no_persistent_compile_cache
     )
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "benchmarks", "zero_8b.py"),
