@@ -521,6 +521,18 @@ def main():
             print(json.dumps(jn), file=sys.stderr)
         except Exception as e:  # noqa: BLE001
             print(f"join phase failed: {e!r}", file=sys.stderr)
+    part = None
+    if time.perf_counter() - t_start < budget_s:
+        try:
+            # partition-tolerance headline (docs/RESILIENCE.md "Orphan
+            # quiesce"): cut 4 gossiping island ranks 3/1, the minority
+            # ORPHANs (heal quorum-denied), then merges back through the
+            # join machinery; cut-to-readmitted-first-round latency
+            from recovery import measure_partition
+            part = measure_partition(nprocs=4)
+            print(json.dumps(part), file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"partition phase failed: {e!r}", file=sys.stderr)
     strag = None
     if time.perf_counter() - t_start < budget_s:
         try:
@@ -665,6 +677,15 @@ def main():
         # transfer + the first grown round
         headline["join_member_switch_range_ms"] = \
             jn["member_switch_range_ms"]
+    if part is not None:
+        headline["partition_merge_ms"] = part["value"]
+        headline["partition_metric"] = part["metric"]
+        # the crash-recovery detector floor the merge beats: the join
+        # request names the orphan's retired identity, so the majority
+        # excises it at the grant instead of waiting out its heartbeats
+        headline["partition_failure_timeout_ms"] = \
+            part["failure_timeout_ms"]
+        headline["partition_consensus_spread"] = part["consensus_spread"]
     if strag is not None:
         headline["straggler_p99_ms"] = strag["value"]
         headline["straggler_metric"] = strag["metric"]

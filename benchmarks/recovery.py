@@ -18,6 +18,7 @@ import os
 import signal
 import sys
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -204,6 +205,157 @@ def measure_join(nprocs: int = 4) -> dict:
     }
 
 
+def _partition_worker(rank, size, job, victim, cut_ev, q):
+    from bluefog_tpu import islands, topology_util
+
+    islands.init(rank, size, job)
+    islands.set_topology(topology_util.ExponentialTwoGraph(size))
+    islands.win_create(np.full(4, float(rank), np.float64), "pm")
+    islands.barrier()
+    q.put(("up", rank, os.getpid(), time.monotonic()))
+    deadline = time.monotonic() + 90.0
+
+    if rank == victim:
+        # steady-state gossip until the parent cuts the link
+        while not cut_ev.is_set() and time.monotonic() < deadline:
+            islands.win_put(islands.win_sync("pm"), "pm")
+            islands.win_update("pm")
+            time.sleep(0.002)
+        # the minority-side view across the cut: every majority rank
+        # looks dead.  The quorum fence must DENY the heal (1 of 4 is
+        # no majority) and park this rank as an ORPHAN instead.
+        t_cut = time.monotonic()
+        healed = islands.heal(dead=set(range(size)) - {victim})
+        assert healed is None and islands.is_orphaned(), healed
+        try:
+            islands.win_put(islands.win_sync("pm"), "pm")
+            raise AssertionError("orphan win_put did not raise")
+        except islands.OrphanedError:
+            pass
+        q.put(("orphan", rank, None, t_cut))
+        # the link heals: merge back through the join machinery,
+        # carrying the pre-cut estimate
+        islands.merge_orphan(timeout=60)
+        islands.win_put(islands.win_sync("pm"), "pm")
+        islands.win_update("pm")
+        q.put(("merged", islands.global_rank(), islands.size(),
+               time.monotonic()))
+    else:
+        # majority side: keep stepping (quorum holds), admit the
+        # orphan when it posts, and heal its abandoned old identity
+        # once the detector times it out
+        grown = None
+        while time.monotonic() < deadline and grown is None:
+            islands.win_put(islands.win_sync("pm"), "pm")
+            islands.win_update("pm")
+            grown = islands.admit_pending(timeout=30)
+        islands.win_put(islands.win_sync("pm"), "pm")
+        islands.win_update("pm")
+        q.put(("grown", islands.global_rank(), islands.size(),
+               time.monotonic()))
+
+    # re-merged fleet: heal the orphan's retired identity when the
+    # detector flags it, then gossip to consensus and report
+    settle = time.monotonic() + 2.0
+    while time.monotonic() < settle:
+        if islands.dead_ranks() - islands._ctx().dead:
+            islands.heal()
+        islands.win_put(islands.win_sync("pm"), "pm")
+        islands.win_update("pm")
+        time.sleep(0.002)
+    q.put(("est", islands.global_rank(),
+           float(np.mean(islands.win_sync("pm"))), time.monotonic()))
+    islands.barrier()
+    islands.shutdown(unlink=False)
+
+
+def measure_partition(nprocs: int = 4, victim: Optional[int] = None,
+                      failure_timeout_s: float = _FAILURE_TIMEOUT_S) -> dict:
+    """Partition ``nprocs`` gossiping island ranks 3/1 (the minority is
+    ``victim``'s view of the cut): the minority's heal is quorum-DENIED
+    and it ORPHANs; on reconnect it merges back through the join
+    machinery carrying its estimate, the majority heals the retired
+    identity, and gossip re-converges.  Returns the metric dict with
+    ``value`` = cut-to-first-gossip-round-as-readmitted-rank ms
+    (bench.py's ``partition_merge_ms`` headline).  Because the join
+    request NAMES the retired identity, the majority excises it at the
+    grant instead of waiting out its heartbeats — so the merge beats
+    the ``failure_timeout_ms`` detector floor that a crash-recovery
+    heal pays; the value is board post + grant + excision + epoch
+    switch + state transfer + one round."""
+    import multiprocessing as mp
+
+    from bluefog_tpu.native import shm_native
+
+    if victim is None:
+        victim = nprocs - 1
+    job = f"part{os.getpid()}"
+    saved = {k: os.environ.get(k)
+             for k in ("BFTPU_FAILURE_TIMEOUT_S", "BFTPU_QUORUM")}
+    os.environ["BFTPU_FAILURE_TIMEOUT_S"] = str(failure_timeout_s)
+    os.environ["BFTPU_QUORUM"] = "majority"
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    cut_ev = ctx.Event()
+    procs = [ctx.Process(target=_partition_worker,
+                         args=(r, nprocs, job, victim, cut_ev, q))
+             for r in range(nprocs)]
+    try:
+        for p in procs:
+            p.start()
+        for _ in range(nprocs):
+            tag, r, _pid, _t = q.get(timeout=300)
+            assert tag == "up"
+        time.sleep(0.3)  # steady-state gossip before the cut
+        cut_ev.set()
+        t_cut = None
+        t_merged = None
+        grown_ms = []
+        ests = {}
+        while len(ests) < nprocs:
+            tag, r, extra, t = q.get(timeout=120)
+            if tag == "orphan":
+                t_cut = t
+            elif tag == "merged":
+                # the retired identity is excised at the grant, so the
+                # re-merged membership is back to nprocs (3 + the
+                # orphan's fresh rank), not nprocs + 1
+                assert extra == nprocs, (tag, r, extra)
+                t_merged = t
+            elif tag == "grown":
+                assert extra == nprocs, (tag, r, extra)
+                grown_ms.append((t - t_cut) * 1000.0)
+            elif tag == "est":
+                ests[r] = extra
+        vals = sorted(ests.values())
+    finally:
+        for p in procs:
+            p.join(timeout=15)
+            if p.is_alive():
+                p.terminate()
+        shm_native.unlink_all(job, ["pm"])
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return {
+        "metric": f"partition cut to first gossip round as the "
+                  f"re-admitted rank ({nprocs - 1}/1 split, exp2, "
+                  f"shm mailbox, quorum=majority)",
+        "value": round((t_merged - t_cut) * 1000.0, 1),
+        "unit": "ms",
+        # the crash-recovery detector floor the merge BEATS: the join
+        # request names the retired identity, so the majority excises
+        # it at the grant instead of waiting out its heartbeats
+        "failure_timeout_ms": round(failure_timeout_s * 1000.0, 1),
+        "majority_grown_range_ms": [round(min(grown_ms), 1),
+                                    round(max(grown_ms), 1)],
+        "consensus_spread": round(vals[-1] - vals[0], 6),
+        "survivors": nprocs - 1,
+    }
+
+
 def _straggler_worker(rank, size, steps):
     """One synchronous-gossip rank for :func:`measure_straggler` — runs
     under ``islands.spawn`` (auto-init'ed).  Per step: deposit, then
@@ -307,4 +459,5 @@ if __name__ == "__main__":
 
     print(json.dumps({"recovery": measure_recovery(),
                       "join": measure_join(),
+                      "partition": measure_partition(),
                       "straggler": measure_straggler()}))

@@ -50,6 +50,13 @@ consumers (CLI, pytest, CI):
   machines finish clean (mass conserved, ledger balanced, consensus at
   quiesce), the same seed replays bit-identically, and a seeded
   invariant bug shrinks to its minimal schedule;
+- **partition** (:mod:`.partition_rules`) — partition tolerance: the
+  production quorum module's strict-majority arithmetic is pinned
+  (even splits have NO quorum on either side), pinned-seed partition
+  campaigns ORPHAN exactly the minority and merge every orphan back
+  to consensus with a balanced ledger, and the seeded ``split_brain``
+  bug is caught by the single-lineage invariant and ddmin-shrinks to
+  the partition fault alone;
 - **lab** (:mod:`.lab_rules`) — the convergence observatory's frozen
   sweep artifact: schema-valid, cell fits refittable from their own
   series, scaling laws non-increasing in fleet size, measured rates
@@ -82,6 +89,7 @@ from bluefog_tpu.analysis import (  # noqa: F401
     hlo_rules,
     introspect_rules,
     lab_rules,
+    partition_rules,
     plan_rules,
     progress_rules,
     resilience_rules,

@@ -106,6 +106,25 @@ def main(argv=None) -> int:
             print(f"self-test FAILED: campaign(s) violated invariants "
                   f"{dirty}")
             return 1
+        # partition arm: acceptance-size partition campaigns must
+        # orphan the minority, merge it back, and replay bit-identically
+        from bluefog_tpu.analysis import partition_rules
+
+        torn = []
+        for label, res, findings in (
+                partition_rules.selftest_partition_campaigns()):
+            ok = not findings
+            print(f"  {label:<36s} "
+                  f"{'clean' if ok else 'VIOLATED'} "
+                  f"(events={res.events}, digest={res.digest[:12]})")
+            for f in findings:
+                print(f"    {f}")
+            if not ok:
+                torn.append(label)
+        if torn:
+            print(f"self-test FAILED: partition campaign(s) failed "
+                  f"{torn}")
+            return 1
         # lab arm: every claim the frozen sweep artifact makes must
         # re-derive from its own raw data (python -m bluefog_tpu.lab
         # --check runs the same checks standalone)
@@ -137,7 +156,8 @@ def main(argv=None) -> int:
             return 1
         print(f"self-test OK: all {len(fixtures.FIXTURES)} seeded bugs "
               f"caught, {len(sim_rules.SELFTEST_PINS)} pinned campaigns "
-              f"clean, lab artifact verified ({ncells} cells)")
+              f"+ {len(partition_rules.PARTITION_PINS)} partition "
+              f"campaigns clean, lab artifact verified ({ncells} cells)")
         return 0
 
     families = args.families

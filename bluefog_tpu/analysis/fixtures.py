@@ -560,6 +560,19 @@ def _sim_cap_bypass() -> List[Finding]:
     return sim_rules.campaign_findings(res, "fixture[sim-cap-bypass]")
 
 
+def _sim_split_brain() -> List[Finding]:
+    """A partition campaign with the quorum fence seeded out
+    (``split_brain``): both sides of the cut heal the other out and
+    commit under diverged membership, which the single-lineage
+    standing invariant must flag (the identical campaign WITH the
+    fence runs clean — partition_rules pins that side)."""
+    from bluefog_tpu.analysis import partition_rules, sim_rules
+
+    _cfg, _sched, res = partition_rules.partition_campaign(
+        16, 30, 3, (6, 11), debug_bugs=("split_brain",))
+    return sim_rules.campaign_findings(res, "fixture[sim-split-brain]")
+
+
 # ---------------------------------------------------------------------------
 # lab fixtures: mutate the REAL frozen sweep artifact (same rationale as
 # the plan fixtures — a schema change that disarms a rule breaks these)
@@ -691,6 +704,7 @@ FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
     # sim family: seeded invariant bugs a full campaign must catch
     "sim-mass-leak": _sim_mass_leak,
     "sim-cap-bypass": _sim_cap_bypass,
+    "sim-split-brain": _sim_split_brain,
     # lab family: tampered sweep artifacts the observatory must reject
     "lab-corrupted-fit": _lab_corrupted_fit,
     "lab-tampered-rate": _lab_tampered_rate,

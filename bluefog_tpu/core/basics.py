@@ -251,6 +251,27 @@ class BlueFogContext:
 _context: Optional[BlueFogContext] = None
 
 
+def _cpu_platform_selected() -> bool:
+    """True when the user pinned jax to the CPU backend (env or config) —
+    checked without touching jax.default_backend(), which would initialize
+    the XLA client before jax.distributed.initialize gets a chance to run."""
+    plats = os.environ.get("JAX_PLATFORMS") or getattr(
+        jax.config, "jax_platforms", None
+    ) or ""
+    return "cpu" in str(plats).replace(" ", "").split(",")
+
+
+def _maybe_enable_cpu_collectives() -> None:
+    """Cross-process collectives on the plain CPU backend need the gloo
+    implementation (jax >= 0.4.34); without it every psum/all-gather across
+    processes raises "Multiprocess computations aren't implemented on the
+    CPU backend".  No-op on jax builds that predate the option."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+
+
 def _distributed_is_initialized() -> bool:
     """jax < 0.5 has no ``jax.distributed.is_initialized``; fall back to the
     client handle the service keeps on the module (None until initialize)."""
@@ -302,6 +323,8 @@ def init(
             kwargs["num_processes"] = int(os.environ["JAX_NUM_PROCESSES"])
         if os.environ.get("JAX_PROCESS_ID"):
             kwargs["process_id"] = int(os.environ["JAX_PROCESS_ID"])
+        if _cpu_platform_selected():
+            _maybe_enable_cpu_collectives()
         jax.distributed.initialize(**kwargs)
     _context = BlueFogContext(devices=devices, local_size=local_size, topology=topology)
 

@@ -115,12 +115,19 @@ def render(snap: dict, rates: Dict[int, float]) -> str:
         conv = page.get("conv", {})
         cerr, cround = conv.get("err", -1.0), conv.get("round", -1)
         conv_s = f"{cerr:.1e}" if cround >= 0 and cerr >= 0.0 else "—"
+        # an ORPHAN rank quiesced on quorum loss — the page freezes at
+        # the denial, so the state outranks whatever op came last
+        last_op = "ORPHAN" if page.get("orphan") else page["last_op"]
         lines.append(
             f"{r:>4} {page['step']:>8} "
             f"{('%.1f' % rate) if rate is not None else '—':>7} "
-            f"{page['epoch']:>5} {page['last_op']:<12} "
+            f"{page['epoch']:>5} {last_op:<12} "
             f"{page['ledger']['balance']:>10.3g} {conv_s:>9} "
             f"{queue:<14} {holds:<8} {edges}")
+    if snap.get("orphans"):
+        lines.append("")
+        lines.append(f"ORPHANED (quorum lost, quiesced): "
+                     f"{', '.join(str(o) for o in snap['orphans'])}")
     if snap.get("suspects"):
         lines.append("")
         lines.append(f"straggler suspects: "

@@ -29,28 +29,33 @@ from typing import Dict, List, Optional, Tuple
 
 from bluefog_tpu.native import shm_native
 
-STATUS_SCHEMA = "bftpu-statuspage/3"
+STATUS_SCHEMA = "bftpu-statuspage/4"
 STATUS_MAGIC = 0x42465350  # "BFSP"
-STATUS_VERSION = 3
+STATUS_VERSION = 4
 
 #: Page layout: header (magic u32, version u32, seq u64), fixed block,
 #: then up to MAX_EDGES edge records; the whole page is padded to
 #: PAGE_BYTES so the file size is stable across republishes.
 #: v2 appends the progress-engine view (queue depth + in-flight op) to
 #: the fixed block; v3 appends the convergence-probe word (consensus
-#: error + probe round).  Readers still decode v1/v2 pages from live
-#: older writers.
+#: error + probe round); v4 appends the flags word (bit 0 = ORPHAN:
+#: this rank lost membership quorum and quiesced — see
+#: docs/RESILIENCE.md "Orphan quiesce").  Readers still decode
+#: v1/v2/v3 pages from live older writers.
 _HEAD = struct.Struct("<IIQ")                 # magic, version, seq
 _FIXED_V1 = struct.Struct("<iiiiQQQdd16sdddd")  # rank, nranks, pid, n_edges,
 #                                                 step, epoch, op_id,
 #                                                 wall_ts, mono_ts, last_op,
 #                                                 ledger dep/col/drn/pend
 _FIXED_V2 = struct.Struct("<iiiiQQQdd16sddddi16s")  # ... + qdepth, inflight
-_FIXED = struct.Struct("<iiiiQQQdd16sddddi16sdq")   # ... + conv_err,
-#                                                       conv_round
+_FIXED_V3 = struct.Struct("<iiiiQQQdd16sddddi16sdq")  # ... + conv_err,
+#                                                         conv_round
+_FIXED = struct.Struct("<iiiiQQQdd16sddddi16sdqi")    # ... + flags
 _EDGE = struct.Struct("<iid")                 # peer_global, state, deadline_s
 MAX_EDGES = 32
 PAGE_BYTES = 1024
+#: flags-word bits (v4)
+FLAG_ORPHAN = 1
 assert _HEAD.size + _FIXED.size + MAX_EDGES * _EDGE.size <= PAGE_BYTES
 
 #: EdgeHealth state codes as written into edge records (3 = demoted is
@@ -88,7 +93,8 @@ class StatusPage:
     def publish(self, *, nranks: int, step: int, epoch: int, op_id: int,
                 last_op: str = "", ledger: Optional[Dict[str, float]] = None,
                 edges=(), qdepth: int = -1, inflight: str = "",
-                conv_err: float = -1.0, conv_round: int = -1) -> None:
+                conv_err: float = -1.0, conv_round: int = -1,
+                flags: int = 0) -> None:
         """Seqlocked single-writer update of the whole page.
 
         ``edges`` is an iterable of ``(peer_global, state_code,
@@ -96,7 +102,8 @@ class StatusPage:
         the ``_LEDGER_KEYS`` to mass totals (missing keys read 0.0);
         ``qdepth``/``inflight`` mirror the rank's progress engine
         (-1 = no engine running); ``conv_err``/``conv_round`` mirror
-        the convergence probe (round -1 = probe off)."""
+        the convergence probe (round -1 = probe off); ``flags`` is the
+        v4 bit set (``FLAG_ORPHAN`` = quorum lost, rank quiesced)."""
         mm = self._seg._mm
         led = ledger or {}
         ed = list(edges)[:MAX_EDGES]
@@ -114,7 +121,7 @@ class StatusPage:
             float(led.get("drained", 0.0)), float(led.get("pending", 0.0)),
             int(qdepth),
             str(inflight).encode("utf-8", "replace")[:16],
-            float(conv_err), int(conv_round))
+            float(conv_err), int(conv_round), int(flags))
         off = _HEAD.size + _FIXED.size
         for peer, state, deadline in ed:
             _EDGE.pack_into(mm, off, int(peer), int(state), float(deadline))
@@ -130,7 +137,7 @@ def _decode(buf: bytes) -> Dict[str, object]:
     magic, version, seq = _HEAD.unpack_from(buf, 0)
     if magic != STATUS_MAGIC:
         raise ValueError(f"not a status page (magic 0x{magic:08x})")
-    if version not in (1, 2, STATUS_VERSION):
+    if version not in (1, 2, 3, STATUS_VERSION):
         raise ValueError(f"unsupported status-page version {version}")
     if version == 1:
         # a live v1 writer (mid-upgrade fleet): no progress-engine block
@@ -139,6 +146,7 @@ def _decode(buf: bytes) -> Dict[str, object]:
             buf, _HEAD.size)
         qdepth, inflight = -1, b""
         conv_err, conv_round = -1.0, -1
+        flags = 0
         fixed_size = _FIXED_V1.size
     elif version == 2:
         # a live v2 writer: progress block, no convergence word
@@ -146,11 +154,19 @@ def _decode(buf: bytes) -> Dict[str, object]:
          last_op, dep, col, drn, pend, qdepth, inflight) = \
             _FIXED_V2.unpack_from(buf, _HEAD.size)
         conv_err, conv_round = -1.0, -1
+        flags = 0
         fixed_size = _FIXED_V2.size
+    elif version == 3:
+        # a live v3 writer: convergence word, no flags word
+        (rank, nranks, pid, n_edges, step, epoch, op_id, wall_ts, mono_ts,
+         last_op, dep, col, drn, pend, qdepth, inflight,
+         conv_err, conv_round) = _FIXED_V3.unpack_from(buf, _HEAD.size)
+        flags = 0
+        fixed_size = _FIXED_V3.size
     else:
         (rank, nranks, pid, n_edges, step, epoch, op_id, wall_ts, mono_ts,
          last_op, dep, col, drn, pend, qdepth, inflight,
-         conv_err, conv_round) = _FIXED.unpack_from(buf, _HEAD.size)
+         conv_err, conv_round, flags) = _FIXED.unpack_from(buf, _HEAD.size)
         fixed_size = _FIXED.size
     edges: List[Dict[str, object]] = []
     off = _HEAD.size + fixed_size
@@ -195,6 +211,9 @@ def _decode(buf: bytes) -> Dict[str, object]:
             "err": float(conv_err) if math.isfinite(conv_err) else -1.0,
             "round": int(conv_round),
         },
+        "flags": int(flags),
+        # quorum-lost quiesce (docs/RESILIENCE.md "Orphan quiesce")
+        "orphan": bool(int(flags) & FLAG_ORPHAN),
         "edges": edges,
     }
 
@@ -284,6 +303,7 @@ def collect(job: str) -> Dict[str, object]:
     suspects = sorted({e["peer"] for p in fleet.values()
                        for e in p.get("edges", ())
                        if e.get("state") == "suspect"})
+    orphans = sorted(r for r, p in fleet.items() if p.get("orphan"))
     return {
         "schema": "bftpu-top/1",
         "job": job,
@@ -292,6 +312,7 @@ def collect(job: str) -> Dict[str, object]:
         "ranks": {str(r): p for r, p in fleet.items()},
         "holders": {str(m): h for m, h in sorted(holders.items())},
         "suspects": suspects,
+        "orphans": orphans,
     }
 
 

@@ -50,11 +50,13 @@ from bluefog_tpu.resilience import adaptive as _adaptive
 from bluefog_tpu.resilience import degraded as _degraded
 from bluefog_tpu.resilience import healing as _healing
 from bluefog_tpu.resilience import join as _join
+from bluefog_tpu.resilience import quorum as _quorum
 from bluefog_tpu.resilience.detector import (
     _EDGE_STATE_CODE,
     EDGE_ALIVE,
     FailureDetector,
 )
+from bluefog_tpu.resilience.quorum import OrphanedError
 from bluefog_tpu.telemetry import registry as _telemetry
 from bluefog_tpu.timeline import timeline_context
 from bluefog_tpu.tracing import tracer as _tracing
@@ -104,6 +106,9 @@ __all__ = [
     "adaptive_step",
     "adaptive_policy",
     "demoted_ranks",
+    "OrphanedError",
+    "is_orphaned",
+    "merge_orphan",
     "spawn",
 ]
 
@@ -199,6 +204,10 @@ class _IslandContext:
         self.detector = FailureDetector(self.shm_job, rank_, size_).start()
         self.dead: set = set()
         self.healed: Optional[_healing.HealedTopology] = None
+        # quorum fencing (resilience/quorum.py): True once this rank
+        # lost a strict-majority live view and quiesced — windows go
+        # read-only, healing stops, merge_orphan() is the way back
+        self.orphaned = False
         # elastic membership (resilience/join.py): epoch 0 is the launch
         # view, where local and global ranks coincide.  After an epoch
         # switch ``rank``/``size``/``job`` describe the CURRENT epoch's
@@ -472,7 +481,91 @@ def dead_ranks() -> set:
     return _ctx().detector.dead_ranks()
 
 
-def heal(dead=None):
+def is_orphaned() -> bool:
+    """Whether this rank is in the ORPHAN quiesce (lost membership
+    quorum; see docs/RESILIENCE.md "Orphan quiesce")."""
+    return _ctx().orphaned
+
+
+def _publish_orphan_page(ctx: "_IslandContext") -> None:
+    """One final status-page publish carrying the ORPHAN flag — the
+    page then freezes (the quiesced rank runs no more window ops), so
+    an attached ``bftpu-top`` keeps showing the verdict."""
+    page = ctx.statuspage
+    if page is None:
+        return
+    from bluefog_tpu.introspect import statuspage as _statuspage
+
+    reg = _telemetry.get_registry()
+    try:
+        page.publish(nranks=len(ctx.members_global), step=ctx.op_rounds,
+                     epoch=ctx.epoch, op_id=ctx.op_rounds,
+                     last_op="ORPHAN",
+                     ledger=_ledger_totals(reg) if reg.enabled else None,
+                     flags=_statuspage.FLAG_ORPHAN)
+    except (OSError, ValueError):
+        pass  # a reaped segment must never fail the quiesce itself
+
+
+def _enter_orphan(ctx: "_IslandContext", live: int, total: int,
+                  op: str) -> None:
+    """The minority-side verdict: freeze instead of forking a second
+    epoch lineage.  Idempotent — only the first denial transitions."""
+    if ctx.orphaned:
+        return
+    ctx.orphaned = True
+    reg = _telemetry.get_registry()
+    if ctx.progress is not None:
+        # park the engine exactly like an epoch switch does: the
+        # in-flight op completes (or times out against the unreachable
+        # side), queued ops stay queued until merge_orphan re-resolves
+        # the world — no resume() until then
+        try:
+            ctx.progress.quiesce()
+        except Exception:  # noqa: BLE001 - quiesce must not mask the verdict
+            pass
+    if reg.enabled:
+        reg.counter("resilience.orphan_entered").inc()
+        reg.journal("orphan_entered", epoch=ctx.epoch,
+                    global_rank=ctx.global_rank, live=live, total=total,
+                    op=op, **_ledger_totals(reg))
+    tr = _tracing.get_tracer()
+    if tr.enabled:
+        tr.instant("orphan_entered", aux=live)
+    _publish_orphan_page(ctx)
+
+
+def _orphan_guard(ctx: "_IslandContext", op: str) -> None:
+    """Raise the retriable :class:`OrphanedError` on any state-mutating
+    window op while quiesced (reads of local state stay allowed)."""
+    if ctx.orphaned:
+        raise OrphanedError(
+            f"{op}: this rank is ORPHANED (minority side of a "
+            f"partition, membership epoch {ctx.epoch}); windows are "
+            "read-only until merge_orphan() re-admits it",
+            live=-1, total=len(ctx.members_global), epoch=ctx.epoch)
+
+
+def _quorum_gate(ctx: "_IslandContext", dead: set, op: str) -> bool:
+    """Quorum fence for heal/demote commits: True = the commit may
+    proceed.  ``dead`` is the would-be local-rank dead set (this
+    rank's view).  A denial enters the ORPHAN quiesce."""
+    if not _quorum.quorum_enabled():
+        return True
+    total = len(ctx.members_global)
+    live = total - len(set(ctx.dead) | set(dead))
+    if _quorum.quorum_met(live, total):
+        return True
+    reg = _telemetry.get_registry()
+    if reg.enabled:
+        reg.counter("resilience.quorum_denied", op=op).inc()
+        reg.journal("quorum_denied", op=op, live=live, total=total,
+                    floor=_quorum.majority_floor(total), epoch=ctx.epoch)
+    _enter_orphan(ctx, live, total, op)
+    return False
+
+
+def heal(dead=None, retiring=()):
     """Excise ``dead`` ranks (default: the detector's verdict) from the
     gossip: force-drain their mailbox slots (a writer that died
     mid-deposit committed zero mass — see DEPOSIT_COMMITS_AFTER_PAYLOAD),
@@ -483,18 +576,42 @@ def heal(dead=None):
     topology, doubly-stochastic W, and recompiled plan — or None when
     nothing is dead.
 
+    ``retiring`` marks local ranks in ``dead`` whose PROCESS is alive —
+    an orphan's abandoned identity, excised at merge-grant time
+    (:func:`admit_pending`).  They are excised and drained like any
+    corpse, but WITHOUT the crash-side ledger settlement: a crashed
+    rank's registry died with it (so the survivor adopts its writer
+    counts and writes off deposits it will never combine), while a
+    retiring rank's registry lives on — it keeps its own writer counts
+    and probes its quiesced inbox as pending in
+    :func:`merge_orphan`, so settling its sides here would
+    double-count both legs of the conservation identity.
+
     Idempotent and rank-local: every survivor calls it on its own
     schedule; no collective required (there is no one left to
     coordinate with — that is the failure mode being handled).
+
+    Quorum-fenced (``BFTPU_QUORUM``, default ``majority``): the heal
+    only commits when this rank still sees a strict majority of the
+    membership epoch as live.  A minority view is a partition, not a
+    mass death — the rank enters the ORPHAN quiesce instead and the
+    call returns None (docs/RESILIENCE.md "Orphan quiesce").
     """
     ctx = _ctx()
     reg = _telemetry.get_registry()
     t0 = time.perf_counter_ns() if reg.enabled else 0
     dead = set(ctx.detector.dead_ranks() if dead is None else dead)
-    for r in dead:
-        ctx.detector.declare_dead(r)
     if not dead:
         return ctx.healed
+    if ctx.orphaned or not _quorum_gate(ctx, dead, "heal"):
+        # quorum fence (BFTPU_QUORUM): a rank that cannot account for
+        # a strict majority as live is the MINORITY side of a
+        # partition, not a survivor — it must not excise "corpses"
+        # that are actually healthy ranks across the cut.  No state
+        # was mutated; merge_orphan() is the way back.
+        return None
+    for r in dead:
+        ctx.detector.declare_dead(r)
     new = dead - ctx.dead
     ctx.dead |= dead
     for r in sorted(new):
@@ -502,6 +619,7 @@ def heal(dead=None):
         breaker = getattr(ctx.shm_job, "mutex_break", None)
         if breaker is not None:
             breaker(r)
+    retiring = set(retiring)
     adopted = written_off = 0
     for win in ctx.windows.values():
         if reg.enabled:
@@ -514,9 +632,11 @@ def heal(dead=None):
             #   creation seed;
             # - edges me->corpse: WRITE OFF my deposits it will never
             #   combine — they leave live circulation as pending.
+            # A RETIRING identity gets neither: its live registry keeps
+            # the writer counts, and merge_orphan probes its inbox.
             rv = getattr(win.shm, "read_version", None)
             for s in win.in_neighbors:
-                if s in new and rv is not None:
+                if s in new and s not in retiring and rv is not None:
                     try:
                         v = int(rv(win.slot_of[ctx.rank][s], src=s))
                     except Exception:  # noqa: BLE001 - accounting only
@@ -524,7 +644,10 @@ def heal(dead=None):
                     if v > win._seed_ver:
                         adopted += v - win._seed_ver
             for r in new:
-                written_off += win._deposited_to.pop(r, 0)
+                if r in retiring:
+                    win._deposited_to.pop(r, None)
+                else:
+                    written_off += win._deposited_to.pop(r, 0)
         drain = getattr(win.shm, "force_drain", None)
         if drain is None:
             continue
@@ -744,14 +867,30 @@ def admit_pending(timeout: Optional[float] = None):
     the first record.
     """
     ctx = _ctx()
+    if ctx.orphaned:
+        return None  # an orphan neither sponsors nor switches epochs
     board = _join.MembershipBoard(ctx.base_job)
     rec = None
     if shm_native.membership_epoch(ctx.base_job) > ctx.epoch:
         rec = board.epoch_record(ctx.epoch + 1)
     if rec is None:
-        if not board.pending_requests():
+        pend = board.pending_requests()
+        if not pend:
             return None
-        if ctx.detector.dead_ranks() - ctx.dead:
+        # a merging orphan names the identity it abandoned: excise it
+        # exactly like a detector-confirmed corpse BEFORE granting —
+        # its heartbeats only stopped at the merge, so the detector may
+        # not have flagged it yet, and a grown view that includes it
+        # would wait forever on the new-epoch barrier
+        g2l = {g: l for l, g in enumerate(ctx.members_global)}
+        stale = {g2l[int(r["retiring"])] for r in pend
+                 if int(r.get("retiring", -1)) in g2l} - ctx.dead
+        if stale:
+            # retiring identities are excised WITHOUT the crash-side
+            # ledger settlement (their live process settles its own
+            # sides at merge — see heal's ``retiring`` contract)
+            heal(set(ctx.detector.dead_ranks()) | stale, retiring=stale)
+        elif ctx.detector.dead_ranks() - ctx.dead:
             heal()  # the grown view must not include a corpse
         reg = _telemetry.get_registry()
         if reg.enabled:
@@ -791,7 +930,8 @@ def admit_pending(timeout: Optional[float] = None):
     return dict(rec)
 
 
-def join(job: Optional[str] = None, timeout: Optional[float] = None):
+def join(job: Optional[str] = None, timeout: Optional[float] = None,
+         retiring: int = -1):
     """Join a LIVE island job as a brand-new rank (the elastic scale-out
     entry point; call INSTEAD of :func:`init`).  Blocks until some
     member admits this process via :func:`admit_pending`, then binds
@@ -804,6 +944,12 @@ def join(job: Optional[str] = None, timeout: Optional[float] = None):
     the same value the survivors agreed on, so admission neither
     creates nor destroys mass (journaled per window as
     ``join_mass_admitted``; counter ``MASS_JOIN_ADMITTED``).
+
+    ``retiring`` names a global rank this process is abandoning —
+    :func:`merge_orphan` re-enters under a fresh rank while its
+    quiesced old identity may still look alive to the majority; the
+    request carries it so :func:`admit_pending` excises the old
+    identity before granting (dead ids are never reissued).
     """
     global _context
     if _context is not None:
@@ -814,7 +960,7 @@ def join(job: Optional[str] = None, timeout: Optional[float] = None):
         raise RuntimeError("join() needs the job name: pass job= or set "
                            "BLUEFOG_ISLAND_JOB")
     board = _join.MembershipBoard(j)
-    req = board.post_request()
+    req = board.post_request(retiring=retiring)
     grant = board.wait_for_grant(req, timeout)
     rec = grant.record
     reg = _telemetry.get_registry()
@@ -893,6 +1039,84 @@ def join(job: Optional[str] = None, timeout: Optional[float] = None):
     return grant
 
 
+def merge_orphan(timeout: Optional[float] = None):
+    """Re-enter the fleet after an ORPHAN quiesce (call when
+    connectivity has returned): tear down the quiesced context and come
+    back through the standard join machinery — membership-board lease →
+    sponsor grant → fresh global rank → epoch switch — **carrying this
+    rank's debiased estimate** into the new epoch.
+
+    The majority side long since healed this rank away, settling both
+    ledger sides from its end; our side settles symmetrically here —
+    deposits still sitting in the quiesced slots are probed as pending
+    before teardown, so the conservation identity holds across
+    partition → heal → merge.  The orphan re-enters each window with
+    unit push-sum mass at its own debiased x̂ (the value it agreed on
+    before the cut), so the merge neither creates nor destroys mass
+    and gossip re-converges to the member-weighted average.
+
+    Blocks until some majority member admits us via
+    :func:`admit_pending`; returns the :class:`~bluefog_tpu.resilience.
+    join.JoinGrant`.  The process keeps its telemetry/trace identity;
+    its global rank changes (dead ids are never reissued).
+    """
+    global _context
+    ctx = _ctx()
+    if not ctx.orphaned:
+        raise RuntimeError("merge_orphan(): this rank is not orphaned "
+                           "(nothing to merge; did heal() deny quorum?)")
+    reg = _telemetry.get_registry()
+    est: Dict[str, np.ndarray] = {}
+    for name, w in ctx.windows.items():
+        x = np.array(w.self_tensor, copy=True)
+        if ctx.associated_p and w.p_self > 0.0:
+            x = np.asarray(x / w.p_self, dtype=x.dtype)
+        est[name] = x
+        if reg.enabled:
+            _ledger_probe_pending(reg, w, ctx.rank)
+    if reg.enabled:
+        reg.counter("resilience.orphan_merged").inc()
+        reg.journal("orphan_merged", epoch=ctx.epoch,
+                    global_rank=ctx.global_rank,
+                    windows=sorted(est), **_ledger_totals(reg))
+    tr = _tracing.get_tracer()
+    if tr.enabled:
+        tr.instant("orphan_merge", aux=ctx.epoch)
+    base_job = ctx.base_job
+    old_identity = ctx.global_rank
+    # teardown, mirroring _switch_epoch's close half: segments are left
+    # for crashed-run hygiene (unlink_all's job glob), the frozen
+    # status page is reclaimed so bftpu-top stops reporting ORPHAN
+    ctx.detector.stop()
+    if ctx.progress is not None:
+        try:
+            ctx.progress.stop()
+        except Exception:  # noqa: BLE001 - a wedged worker must not block merge
+            pass
+    for w in ctx.windows.values():
+        w.shm.close(unlink=False)
+    ctx.shm_job.close(unlink=False)
+    if ctx.statuspage is not None:
+        ctx.statuspage.close(unlink=True)
+        ctx.statuspage = None
+    _context = None
+    # the request names the abandoned identity so the majority excises
+    # it before granting (it would never ack the new-epoch barrier)
+    grant = join(base_job, timeout, retiring=old_identity)
+    nctx = _ctx()
+    for name, x in est.items():
+        w = nctx.windows.get(name)
+        if w is None:
+            continue  # the window was freed on the majority side
+        # overwrite the sponsor-onboarded value with the carried
+        # estimate: mass stays the unit p the grant admitted, only the
+        # value differs — slot seeds are version-fenced (seed_ver), so
+        # no combine mixes the stale sponsor copy back in
+        w.self_tensor = np.asarray(x, dtype=w.shm.dtype)
+        w.shm.expose(w.self_tensor, w.p_self)
+    return grant
+
+
 # ---------------------------------------------------------------------------
 # adaptive topology: the straggler demote/promote control loop
 # (resilience/adaptive.py; docs/RESILIENCE.md "Adaptive topology")
@@ -938,7 +1162,11 @@ def _is_anchor(ctx: "_IslandContext", g: int) -> bool:
 
 def _commit_reweight(ctx: "_IslandContext", board, demote=(), promote=()):
     """Compute the deterministic reweight record and race it onto the
-    board (first observer wins; the rest adopt the committed record)."""
+    board (first observer wins; the rest adopt the committed record).
+    Quorum-fenced like :func:`heal`: a minority view may not commit a
+    demote/promote epoch either — same split-brain, different door."""
+    if ctx.orphaned or not _quorum_gate(ctx, set(), "reweight"):
+        return None
     base = ctx.base_edges
     if base is None:
         G0 = _members_graph_global(ctx)
@@ -1003,7 +1231,7 @@ def adaptive_step():
     """
     ctx = _ctx()
     pol = ctx.adaptive
-    if pol is None:
+    if pol is None or ctx.orphaned:
         return None
     board = _join.MembershipBoard(ctx.base_job)
     # 1. observe: someone committed an epoch I have not switched into
@@ -1272,6 +1500,7 @@ def win_put(tensor, name: str, dst_weights: WeightDict = None) -> bool:
     tensor (upstream the window aliases the tensor's memory)."""
     with timeline_context("island_win_put"):
         ctx = _ctx()
+        _orphan_guard(ctx, "win_put")
         win = _win(name)
         reg = _telemetry.get_registry()
         tr = _tracing.get_tracer()
@@ -1538,6 +1767,7 @@ def win_accumulate(tensor, name: str, dst_weights: WeightDict = None) -> bool:
     is invariant — the push-sum conservation law."""
     with timeline_context("island_win_accumulate"):
         ctx = _ctx()
+        _orphan_guard(ctx, "win_accumulate")
         win = _win(name)
         reg = _telemetry.get_registry()
         tr = _tracing.get_tracer()
@@ -1674,6 +1904,7 @@ def win_put_async(tensor, name: str, dst_weights: WeightDict = None):
     device→host transfer belongs).  CONTRACT: do not donate/delete the
     payload until the handle resolves."""
     win = _win(name)  # surface unknown-window errors at the call site
+    _orphan_guard(_ctx(), "win_put_async")
     eng = progress_engine()
     if eng is None:
         t = tensor() if callable(tensor) else tensor
@@ -1688,6 +1919,7 @@ def win_accumulate_async(tensor, name: str,
     :func:`win_put_async`.  Fused runs deposit their sum once; the mass
     ledger balance is unchanged because accumulation is additive."""
     win = _win(name)
+    _orphan_guard(_ctx(), "win_accumulate_async")
     eng = progress_engine()
     if eng is None:
         t = tensor() if callable(tensor) else tensor
@@ -1706,6 +1938,7 @@ def win_update_async(name: str, self_weight: Optional[float] = None,
     result is always an independent copy (``clone`` semantics): it must
     stay valid while later queued ops keep mutating the window."""
     _win(name)
+    _orphan_guard(_ctx(), "win_update_async")
     eng = progress_engine()
     if eng is None:
         return _progress.completed(win_update(
@@ -1722,6 +1955,7 @@ def win_get(name: str, src_weights: WeightDict = None) -> bool:
     MPI_Get [U])."""
     with timeline_context("island_win_get"):
         ctx = _ctx()
+        _orphan_guard(ctx, "win_get")
         win = _win(name)
         reg = _telemetry.get_registry()
         t0 = time.perf_counter_ns() if reg.enabled else 0
@@ -1872,6 +2106,7 @@ def win_update(
     deposits are never lost — the accumulate idiom."""
     with timeline_context("island_win_update"):
         ctx = _ctx()
+        _orphan_guard(ctx, "win_update")
         win = _win(name)
         reg = _telemetry.get_registry()
         tr = _tracing.get_tracer()
@@ -2463,6 +2698,9 @@ class DistributedWinPutOptimizer:
         import jax
         import optax
 
+        # fail BEFORE the local update: an orphaned rank's step must be
+        # retriable as a unit once merge_orphan() re-admits it
+        _orphan_guard(_ctx(), "DistributedWinPutOptimizer.step")
         if self.overlap:
             # combine-then-adapt on the freshest gossip: the in-flight
             # round deposited LAST step's params while the caller computed

@@ -103,6 +103,17 @@ _OP_NAMES = {
     _OP_CHUNK: "chunk", _OP_COMMIT: "commit",
 }
 
+#: ops a mid-exchange disconnect may safely REPLAY on a fresh
+#: connection: pure reads of server state.  Mutation ops (write,
+#: mutex, barrier, join_rank) stay one-shot — the server may have
+#: applied the lost request, and re-sending would double-apply.
+#: Chunked deposits get their own replay rule in ``deposit_chunked``
+#: (safe up to the commit frame, which is where state advances).
+_IDEMPOTENT_OPS = frozenset({
+    _OP_READ_EXPOSED, _OP_PING, _OP_HEARTBEAT, _OP_LIVENESS,
+    _OP_CLOCK, _OP_EPOCH,
+})
+
 # op, win_id, slot, mode, nbytes, p, trace — the trace word is LAST so
 # pre-trace header fields keep their offsets on the wire
 _HDR = struct.Struct("<iiiiqdQ")
@@ -133,6 +144,31 @@ def peer_timeout_s() -> Optional[float]:
     except ValueError:
         t = 120.0
     return t if t > 0 else None
+
+
+def tcp_retries() -> int:
+    """Session-resume attempts after a DISCONNECT-class failure
+    (``BFTPU_TCP_RETRIES``, default 3; 0 restores the old one-shot
+    behavior where the next request reconnects but the failing one
+    raises).  Only connection drops are retried — a connected peer
+    that stays silent is the failure detector's business and still
+    surfaces as :class:`PeerTimeoutError` after one deadline."""
+    try:
+        n = int(os.environ.get("BFTPU_TCP_RETRIES", "3"))
+    except ValueError:
+        n = 3
+    return max(n, 0)
+
+
+def tcp_backoff_s() -> float:
+    """Base of the bounded exponential reconnect backoff
+    (``BFTPU_TCP_BACKOFF_S``, default 0.05): retry ``k`` sleeps
+    ``base * 2**k`` seconds, capped at 2 s per step."""
+    try:
+        b = float(os.environ.get("BFTPU_TCP_BACKOFF_S", "0.05"))
+    except ValueError:
+        b = 0.05
+    return max(b, 0.0)
 
 
 def tcp_chunked() -> bool:
@@ -177,6 +213,23 @@ def _chunk_kill_after(src_rank: int) -> int:
     except ValueError:
         pass
     return -1
+
+
+def _chunk_drop_after() -> int:
+    """Chaos hook: ``BFTPU_CHAOS_DROP_CHUNK="<n>"`` makes the RECEIVING
+    server drop the connection after accepting ``<n>`` chunk frames of
+    one stream, ONE TIME per server — the deterministic mid-stream
+    disconnect the session-resume tests need (a real link flap cannot
+    be timed).  The writer sees ConnectionError with the commit unsent,
+    so the bounded-backoff retry must replay the stream from chunk 0
+    and lose nothing.  Returns -1 when unset."""
+    spec = os.environ.get("BFTPU_CHAOS_DROP_CHUNK")
+    if not spec:
+        return -1
+    try:
+        return int(spec)
+    except ValueError:
+        return -1
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -391,6 +444,8 @@ class _Server:
         self.join_lock = threading.Lock()
         self.next_join_rank = nranks
         self.membership_epoch = 0
+        # one-shot latch for the BFTPU_CHAOS_DROP_CHUNK disconnect hook
+        self._chaos_dropped = False
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.sock.bind((host, port))
@@ -475,6 +530,14 @@ class _Server:
                 raise ConnectionError("chunk overruns window")
             st["next"] = idx + 1
             st["elems"] += cnt
+            drop_n = _chunk_drop_after()
+            if drop_n >= 0 and idx + 1 >= drop_n \
+                    and not self._chaos_dropped:
+                # one-shot chaos disconnect: the stream dies UNCOMMITTED
+                # (the disconnect drain restores the slot), the writer's
+                # session resume replays it from chunk 0
+                self._chaos_dropped = True
+                raise ConnectionError("chaos: scheduled mid-stream drop")
             do_acc = acc and not st["fresh"]
             dest = (memoryview(s.data)[off * item:off * item + nbytes]
                     if code == wire_codec.WIRE_RAW and not do_acc else None)
@@ -762,6 +825,22 @@ class _Peers:
         except OSError:
             pass
 
+    def _backoff(self, rank: int, attempt: int, opname: str) -> None:
+        """One bounded-exponential backoff step before a reconnect."""
+        delay = min(tcp_backoff_s() * (2 ** attempt), 2.0)
+        reg = _telemetry.get_registry()
+        if reg.enabled:
+            reg.histogram("tcp.retry_backoff_s", op=opname).observe(delay)
+            reg.journal("tcp_retry", peer_rank=rank, op=opname,
+                        attempt=attempt + 1, backoff_s=delay)
+        if delay > 0:
+            time.sleep(delay)
+
+    def _note_reconnect(self, opname: str) -> None:
+        reg = _telemetry.get_registry()
+        if reg.enabled:
+            reg.counter("tcp.reconnects", op=opname).inc()
+
     def _timeout_error(self, rank: int, opname: str) -> PeerTimeoutError:
         reg = _telemetry.get_registry()
         addr = self.table.get(rank)
@@ -786,17 +865,34 @@ class _Peers:
         t0 = time.perf_counter_ns() if reg.enabled else 0
         lock = self.locks.setdefault(rank, threading.Lock())
         with lock:
-            conn = self._connect(rank)
-            try:
-                _send_msg(conn, op, win_id, slot, mode, p, payload,
-                          trace=trace)
-                reply = _recv_msg(conn)
-            except socket.timeout as e:
-                self._evict(rank, conn)
-                raise self._timeout_error(rank, opname) from e
-            except (ConnectionError, OSError):
-                self._evict(rank, conn)
-                raise
+            attempt = 0
+            while True:
+                conn = None
+                try:
+                    conn = self._connect(rank)
+                    if attempt:
+                        self._note_reconnect(opname)
+                    _send_msg(conn, op, win_id, slot, mode, p, payload,
+                              trace=trace)
+                    reply = _recv_msg(conn)
+                    break
+                except socket.timeout as e:
+                    # deliberately NOT retried: the peer is connected
+                    # but silent — reconnecting can't help, and the
+                    # failure detector owns this verdict
+                    self._evict(rank, conn)
+                    raise self._timeout_error(rank, opname) from e
+                except (ConnectionError, OSError):
+                    if conn is not None:
+                        self._evict(rank, conn)
+                    # a failure INSIDE _connect (conn is None) never
+                    # reached the server, so any op may retry it; a
+                    # mid-exchange drop replays only idempotent ops
+                    replayable = conn is None or op in _IDEMPOTENT_OPS
+                    if not replayable or attempt >= tcp_retries():
+                        raise
+                    self._backoff(rank, attempt, opname)
+                    attempt += 1
         if reg.enabled:
             reg.counter("tcp.round_trips", op=opname).inc()
             reg.counter("tcp.acks").inc()
@@ -839,65 +935,94 @@ class _Peers:
         wire_bytes = 0
         lock = self.locks.setdefault(rank, threading.Lock())
         with lock:
-            conn = self._connect(rank)
-            try:
-                # frames coalesce into half-credit-window sendmsg iovecs
-                # (one syscall apiece), acks drain in matching bulk
-                # recvs; the chaos kill path flushes per frame so the
-                # "die after n chunk frames" schedule stays exact
-                batch = max(credit // 2, 1) if kill_after < 0 else 1
-                outstanding = 0
-                pend = 0
-                iov = []
-                for idx in range(nchunks):
-                    lo = idx * elems
-                    hi = min(lo + elems, total)
-                    view = buf[lo:hi]
-                    code_i, payload, scale = wire_codec.encode_chunk(
-                        view, code)
-                    iov.append(_HDR.pack(
-                        _OP_CHUNK, win_id, slot,
-                        (idx << 8) | (code_i << 1) | acc,
-                        len(payload), scale, lo))
-                    if payload:
-                        iov.append(payload)
-                    pend += 1
-                    wire_bytes += _HDR.size + len(payload)
-                    if residual is not None:
-                        if code_i == wire_codec.WIRE_RAW:
-                            residual[lo:hi] = 0  # wire was exact
-                        else:
-                            residual[lo:hi] = view - wire_codec.decode_chunk(
-                                payload, code_i, scale, arr.dtype, hi - lo)
-                    if pend >= batch:
+            attempt = 0
+            while True:
+                conn = None
+                commit_sent = False
+                wire_bytes = 0
+                try:
+                    conn = self._connect(rank)
+                    if attempt:
+                        self._note_reconnect("write_chunked")
+                    # frames coalesce into half-credit-window sendmsg
+                    # iovecs (one syscall apiece), acks drain in matching
+                    # bulk recvs; the chaos kill path flushes per frame so
+                    # the "die after n chunk frames" schedule stays exact
+                    batch = max(credit // 2, 1) if kill_after < 0 else 1
+                    outstanding = 0
+                    pend = 0
+                    iov = []
+                    for idx in range(nchunks):
+                        lo = idx * elems
+                        hi = min(lo + elems, total)
+                        view = buf[lo:hi]
+                        code_i, payload, scale = wire_codec.encode_chunk(
+                            view, code)
+                        iov.append(_HDR.pack(
+                            _OP_CHUNK, win_id, slot,
+                            (idx << 8) | (code_i << 1) | acc,
+                            len(payload), scale, lo))
+                        if payload:
+                            iov.append(payload)
+                        pend += 1
+                        wire_bytes += _HDR.size + len(payload)
+                        if residual is not None:
+                            # pure function of `buf` (encode is
+                            # deterministic), so a stream REPLAY after a
+                            # disconnect rewrites the same residuals —
+                            # no pre-attempt snapshot needed
+                            if code_i == wire_codec.WIRE_RAW:
+                                residual[lo:hi] = 0  # wire was exact
+                            else:
+                                residual[lo:hi] = \
+                                    view - wire_codec.decode_chunk(
+                                        payload, code_i, scale,
+                                        arr.dtype, hi - lo)
+                        if pend >= batch:
+                            over = outstanding + pend - credit
+                            if over > 0:  # honor the credit window FIRST
+                                _drain_acks(conn, over)
+                                outstanding -= over
+                            _send_iov(conn, iov)
+                            iov = []
+                            outstanding += pend
+                            pend = 0
+                        if kill_after >= 0 and idx + 1 >= kill_after:
+                            from bluefog_tpu.resilience.chaos import \
+                                kill_self
+                            kill_self()
+                    if pend:
                         over = outstanding + pend - credit
-                        if over > 0:  # honor the credit window FIRST
+                        if over > 0:
                             _drain_acks(conn, over)
                             outstanding -= over
                         _send_iov(conn, iov)
-                        iov = []
                         outstanding += pend
-                        pend = 0
-                    if kill_after >= 0 and idx + 1 >= kill_after:
-                        from bluefog_tpu.resilience.chaos import kill_self
-                        kill_self()
-                if pend:
-                    over = outstanding + pend - credit
-                    if over > 0:
-                        _drain_acks(conn, over)
-                        outstanding -= over
-                    _send_iov(conn, iov)
-                    outstanding += pend
-                _send_msg(conn, _OP_COMMIT, win_id, slot,
-                          (nchunks << 1) | acc, float(p), trace=trace)
-                wire_bytes += _HDR.size
-                _drain_acks(conn, outstanding + 1)
-            except socket.timeout as e:
-                self._evict(rank, conn)
-                raise self._timeout_error(rank, "write_chunked") from e
-            except (ConnectionError, OSError):
-                self._evict(rank, conn)
-                raise
+                    # point of no replay: once any commit-frame byte may
+                    # be on the wire the server MAY have advanced the
+                    # slot version and mass — re-sending would
+                    # double-commit, so failures past here raise
+                    commit_sent = True
+                    _send_msg(conn, _OP_COMMIT, win_id, slot,
+                              (nchunks << 1) | acc, float(p), trace=trace)
+                    wire_bytes += _HDR.size
+                    _drain_acks(conn, outstanding + 1)
+                    break
+                except socket.timeout as e:
+                    self._evict(rank, conn)
+                    raise self._timeout_error(rank, "write_chunked") from e
+                except (ConnectionError, OSError):
+                    if conn is not None:
+                        self._evict(rank, conn)
+                    # an UNCOMMITTED stream is replay-safe: the server
+                    # advances version/mass only at _OP_COMMIT
+                    # (TCP_DEPOSIT_COMMITS_AFTER_PAYLOAD) and its
+                    # disconnect handler drained the torn stream, so the
+                    # retry re-opens chunk 0 against a clean slot
+                    if commit_sent or attempt >= tcp_retries():
+                        raise
+                    self._backoff(rank, attempt, "write_chunked")
+                    attempt += 1
         if reg.enabled:
             reg.counter("tcp.round_trips", op="write_chunked").inc()
             reg.counter("tcp.acks").add(nchunks + 1)

@@ -176,8 +176,11 @@ class ProgressEngine:
     def quiesce(self, timeout: float = 60.0) -> int:
         """Park the worker: the in-flight op completes, queued ops stay
         queued.  Called by the epoch switch BEFORE the old epoch's shm
-        segments close; returns the number of ops that will re-execute
-        against the new epoch's windows after :meth:`resume`."""
+        segments close — and by the ORPHAN transition on quorum loss
+        (islands._enter_orphan), where no :meth:`resume` follows until
+        ``merge_orphan`` re-admits the rank under a fresh epoch.
+        Returns the number of ops that will re-execute against the new
+        epoch's windows after :meth:`resume`."""
         with self._cv:
             self._quiesced = True
             pending = len(self._q)

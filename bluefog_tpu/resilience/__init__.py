@@ -59,6 +59,13 @@ from bluefog_tpu.resilience.join import (
     join_poll_s,
     join_timeout_s,
 )
+from bluefog_tpu.resilience.quorum import (
+    OrphanedError,
+    majority_floor,
+    quorum_enabled,
+    quorum_met,
+    quorum_mode,
+)
 
 __all__ = [
     "FailureDetector",
@@ -90,4 +97,9 @@ __all__ = [
     "epoch_job",
     "join_poll_s",
     "join_timeout_s",
+    "OrphanedError",
+    "quorum_mode",
+    "quorum_enabled",
+    "quorum_met",
+    "majority_floor",
 ]

@@ -49,6 +49,7 @@ __all__ = [
     "schedule_join",
     "schedule_suspend",
     "schedule_slow",
+    "schedule_partition",
     "schedule_to_json",
     "apply_schedule_json",
     "clear_schedule",
@@ -68,11 +69,15 @@ _SLOW_RANK = "BFTPU_CHAOS_SLOW_RANK"
 _SLOW_STEP = "BFTPU_CHAOS_SLOW_STEP"
 _SLOW_S = "BFTPU_CHAOS_SLOW_S"
 _SLOW_STOP = "BFTPU_CHAOS_SLOW_STOP"
+_PARTITION_GROUP = "BFTPU_CHAOS_PARTITION_GROUP"
+_PARTITION_STEP = "BFTPU_CHAOS_PARTITION_STEP"
+_PARTITION_STOP = "BFTPU_CHAOS_PARTITION_STOP"
 
 _ALL_KEYS = (_KILL_RANK, _KILL_STEP, _DELAY_S,
              _JOIN_RANK, _JOIN_STEP,
              _SUSPEND_RANK, _SUSPEND_STEP, _SUSPEND_S,
-             _SLOW_RANK, _SLOW_STEP, _SLOW_S, _SLOW_STOP)
+             _SLOW_RANK, _SLOW_STEP, _SLOW_S, _SLOW_STOP,
+             _PARTITION_GROUP, _PARTITION_STEP, _PARTITION_STOP)
 
 # sim-campaign knobs (bluefog_tpu/sim/__main__.py reads these as CLI
 # defaults) — scrubbed by clear_schedule() alongside the chaos keys,
@@ -81,7 +86,8 @@ _ALL_KEYS = (_KILL_RANK, _KILL_STEP, _DELAY_S,
 _SIM_KEYS = ("BFTPU_SIM_SEED", "BFTPU_SIM_RANKS", "BFTPU_SIM_ROUNDS",
              "BFTPU_SIM_FAULTS", "BFTPU_SIM_TOPOLOGY",
              "BFTPU_SIM_SCHEDULE", "BFTPU_SIM_QUIESCE_ROUNDS",
-             "BFTPU_SIM_LATENCY_MS", "BFTPU_SIM_REPRO_DIR")
+             "BFTPU_SIM_LATENCY_MS", "BFTPU_SIM_REPRO_DIR",
+             "BFTPU_SIM_QUORUM")
 
 # convergence-observatory knobs (bluefog_tpu.lab): a stale probe or
 # auto-topology flag leaking across tests changes the next fleet's hot
@@ -194,6 +200,29 @@ def schedule_slow(env: dict, rank: int, step: int, delay_s: float,
     env[_SLOW_S] = str(float(delay_s))
     if stop is not None:
         env[_SLOW_STOP] = str(int(stop))
+    return env
+
+
+def schedule_partition(env: dict, group: str, step: int,
+                       stop: Optional[int] = None) -> dict:
+    """Publish a NETWORK PARTITION schedule: from step ``step`` until
+    step ``stop`` (exclusive), cross-group traffic drops and liveness
+    goes stale across the cut.  ``group`` is the side spec — a
+    pipe-separated list of comma-separated global ranks (``"3"`` =
+    rank 3 vs everyone else; see
+    :meth:`bluefog_tpu.sim.schedule.Fault.partition`).
+
+    Unlike the other chaos kinds, :func:`checkpoint` does NOT act on
+    these keys — a worker cannot self-inject a network property.  The
+    keys exist so a partition campaign round-trips through the shared
+    fault-schedule format (``schedule_to_json`` /
+    ``apply_schedule_json``) and so harnesses that DO own the network
+    (the fleet simulator; an iptables-driven e2e rig) can read one
+    schedule spelling."""
+    env[_PARTITION_GROUP] = str(group)
+    env[_PARTITION_STEP] = str(int(step))
+    if stop is not None:
+        env[_PARTITION_STOP] = str(int(stop))
     return env
 
 

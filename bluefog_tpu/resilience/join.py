@@ -220,8 +220,17 @@ class MembershipBoard:
 
     # -- joiner side ------------------------------------------------------
 
-    def post_request(self) -> str:
-        """Publish a join request; returns the request id to poll on."""
+    def post_request(self, retiring: int = -1) -> str:
+        """Publish a join request; returns the request id to poll on.
+
+        ``retiring`` names a global rank this joiner is abandoning — a
+        merging orphan re-enters under a fresh rank while its quiesced
+        old identity still looks alive (heartbeats only stopped at the
+        merge).  Members MUST excise it before granting: the grown
+        view's new-epoch barrier would otherwise wait forever on an
+        identity that never switches (``islands.admit_pending`` treats
+        it exactly like a detector-confirmed corpse).
+        """
         req_id = f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:8]}"
         with self._locked():
             doc = self.read()
@@ -229,9 +238,11 @@ class MembershipBoard:
                 raise RuntimeError(
                     f"no membership board for job {self.job!r} — is the "
                     "job running (islands.init publishes the board)?")
-            doc["requests"].append({"req": req_id, "pid": os.getpid(),
-                                    "host": socket.gethostname(),
-                                    "t": time.time()})
+            req = {"req": req_id, "pid": os.getpid(),
+                   "host": socket.gethostname(), "t": time.time()}
+            if int(retiring) >= 0:
+                req["retiring"] = int(retiring)
+            doc["requests"].append(req)
             self._publish(doc)
         return req_id
 

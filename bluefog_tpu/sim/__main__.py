@@ -11,7 +11,8 @@ Flags default from the sim env family — ``BFTPU_SIM_RANKS``,
 ``BFTPU_SIM_ROUNDS``, ``BFTPU_SIM_SEED``, ``BFTPU_SIM_TOPOLOGY``,
 ``BFTPU_SIM_FAULTS``, ``BFTPU_SIM_QUIESCE_ROUNDS``,
 ``BFTPU_SIM_LATENCY_MS``, ``BFTPU_SIM_SCHEDULE``,
-``BFTPU_SIM_REPRO_DIR`` (all documented in docs/OBSERVABILITY.md) —
+``BFTPU_SIM_REPRO_DIR``, ``BFTPU_SIM_QUORUM`` (all documented in
+docs/OBSERVABILITY.md) —
 so a chaos-style harness can parameterize a campaign the same way it
 parameterizes a fault schedule; explicit flags always win.
 
@@ -110,7 +111,13 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--debug-bug", action="append", default=[],
                     metavar="NAME",
                     help="seed an intentional bug (mass_leak, "
-                         "cap_bypass) — the campaign should CATCH it")
+                         "cap_bypass, split_brain) — the campaign "
+                         "should CATCH it")
+    ap.add_argument("--quorum", choices=("majority", "off"),
+                    default=str(_env("BFTPU_SIM_QUORUM", "majority")),
+                    help="membership-commit quorum fence (mirrors "
+                         "BFTPU_QUORUM; explicit in the config so "
+                         "repro files replay identically)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable summary on stdout")
     return ap
@@ -164,6 +171,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         latency_s=tuple(args.latency_ms),
         journal_dir=args.journal_dir,
         debug_bugs=tuple(args.debug_bug),
+        quorum=args.quorum,
     )
     schedule = None
     if args.schedule:

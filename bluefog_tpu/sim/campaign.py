@@ -85,9 +85,20 @@ class SimConfig:
     # quiesce; the seeded-bug magnitudes the check exists to catch
     # (leaked mass, non-stochastic plans) sit orders above this
     consensus_tol: float = 2e-3
+    # quorum fencing for membership commits (mirrors BFTPU_QUORUM,
+    # but explicit so repro files replay identically regardless of the
+    # environment): "majority" fences heal/demote commits on a
+    # strict-majority live set — the partition minority ORPHANs and
+    # merges back on heal; "off" lets every side heal (pre-quorum
+    # behavior, split-brain territory under partitions)
+    quorum: str = "majority"
     # plumbing
     max_events: int = 20_000_000
     journal_dir: Optional[str] = None
+    # seeded bugs the campaign should CATCH: mass_leak (combine leaks
+    # mass), cap_bypass (no minority demotion cap), split_brain (the
+    # quorum fence is skipped, so both partition sides heal and the
+    # single-lineage invariant fires)
     debug_bugs: Tuple[str, ...] = ()
     # convergence observatory (bluefog_tpu.lab): record per-rank
     # successive-estimate differences each round.  The trace rides in

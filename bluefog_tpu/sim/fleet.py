@@ -37,7 +37,15 @@ on the victim's own round counter, exactly like
 - ``join`` — a joiner posts on the board and blocks in
   ``wait_for_grant`` on the virtual clock; the sponsor (lowest live
   global rank) grants via the real ``grant`` path and the joiner
-  enters with unit mass at the sponsor's debiased estimate.
+  enters with unit mass at the sponsor's debiased estimate;
+- ``partition`` — cross-group traffic drops and liveness/epoch words
+  freeze across the cut for a window of rounds; each side's detector
+  times the other out, the quorum fence (same rule as
+  ``bluefog_tpu.resilience.quorum``) lets only a strict-majority side
+  heal while the minority ORPHANs (parks its rounds, touches neither
+  the board nor the shared ledgers), and on heal the orphans merge
+  back through the real join machinery carrying their debiased
+  estimates with their stale mass written off.
 
 Invariants are checked after every protocol event (see
 :mod:`bluefog_tpu.sim.invariants`); violations are recorded, never
@@ -53,6 +61,7 @@ from typing import Dict, List, Optional, Set, Tuple
 import networkx as nx
 
 from bluefog_tpu.resilience import healing as _healing
+from bluefog_tpu.resilience import quorum as _quorum
 from bluefog_tpu.resilience.adaptive import AdaptivePolicy
 from bluefog_tpu.resilience.detector import (
     EDGE_ALIVE, EdgeHealth, FailureDetector)
@@ -90,6 +99,7 @@ class SimRank:
         self.slow_delay = 0.0
         self.exited = False
         self.killed = False
+        self.orphaned = False
         self.detector: Optional[FailureDetector] = None
         self.health: Optional[EdgeHealth] = None
         self.policy: Optional[AdaptivePolicy] = None
@@ -133,12 +143,32 @@ class SimFleet:
         self._registries: Dict[int, object] = {}
         self.ranks: Dict[int, SimRank] = {}
         self.joiners_spawned = 0
-        # faults indexed by (victim global rank, step); joins by step
+        self.orphans_merged = 0
+        # quorum fencing mirrors the production rule (cfg.quorum is
+        # explicit so repro files replay identically regardless of
+        # BFTPU_QUORUM); the split_brain seeded bug disables the fence
+        # so the single-lineage invariant can catch the violation
+        self._quorum_on = (
+            getattr(cfg, "quorum", "majority") != "off"
+            and "split_brain" not in getattr(cfg, "debug_bugs", ()))
+        # active partition window state: global rank -> group id while
+        # a cut is live, the set of group ids that committed membership
+        # progress during the window, and the cut-time mass anchor for
+        # the partition+merge conservation invariant
+        self._partition: Optional[Dict[int, int]] = None
+        self._board_group = 0
+        self._lineage: Set[int] = set()
+        self._partition_anchor: Optional[Tuple[float, float]] = None
+        # faults indexed by (victim global rank, step); joins and
+        # partitions fire on their own timers (no single victim)
         self._faults: Dict[Tuple[int, int], Fault] = {}
         self._join_faults: List[Fault] = []
+        self._partition_faults: List[Fault] = []
         for f in self.schedule:
             if f.kind == "join":
                 self._join_faults.append(f)
+            elif f.kind == "partition":
+                self._partition_faults.append(f)
             else:
                 self._faults[(f.rank, f.step)] = f
         self._build()
@@ -238,6 +268,14 @@ class SimFleet:
         for f in self._join_faults:
             self.loop.at(_T0 + f.step * cfg.round_period,
                          self._joiner_event(f))
+        for f in self._partition_faults:
+            t0 = _T0 + f.step * cfg.round_period
+            if f.stop is not None:
+                t1 = _T0 + f.stop * cfg.round_period
+            else:
+                t1 = t0 + (f.duration_s or 5 * cfg.round_period)
+            self.loop.at(t0, self._partition_start_event(f))
+            self.loop.at(max(t1, t0), self._partition_end_event(f))
         self.end_time = _T0 + (cfg.rounds + cfg.quiesce_rounds + 2) \
             * cfg.round_period
 
@@ -290,6 +328,11 @@ class SimFleet:
         def fire():
             r = self.ranks.get(g)
             if r is None or r.killed or r.exited:
+                return
+            if r.orphaned:
+                # parked: an orphan runs no rounds (windows frozen,
+                # progress engine quiesced).  The partition-heal event
+                # owns its future — merge-back or fencing.
                 return
             now = self.loop.now
             if now < r.suspended_until:
@@ -359,7 +402,9 @@ class SimFleet:
         # with a chaos join schedule of rank=-1).  The transport-level
         # flag (kept current by SimBoard._publish) makes the common
         # no-joiner round skip the board's JSON parse entirely.
-        if self.transport.join_pending and self.board.pending_requests():
+        if self.transport.join_pending \
+                and self.transport.board_reachable(r.g) \
+                and self.board.pending_requests():
             live = r.live_members()
             if live and r.g == min(live):
                 self._grant(r)
@@ -389,6 +434,16 @@ class SimFleet:
     # -- membership machinery ---------------------------------------------
 
     def _heal(self, r: SimRank, new_dead: Set[int]) -> None:
+        # quorum fence BEFORE any settlement: a minority-side heal
+        # would adopt a live peer's ledger and fork the lineage — the
+        # orphan must park without touching shared state
+        if self._quorum_on:
+            total = len(r.epoch_members)
+            dead_all = (r.known_dead | new_dead) & set(r.epoch_members)
+            live = total - len(dead_all)
+            if not _quorum.quorum_met(live, total):
+                self._enter_orphan(r, live, total)
+                return
         for d in sorted(new_dead):
             settlement = self.transport.heal_settle(r.g, d, r.epoch)
             self._journal(r.g, "heal", dead=[d], epoch=r.epoch,
@@ -419,6 +474,7 @@ class SimFleet:
         r.members = survivors
         r.graph = healed.topology
         r.cfg_key = key
+        self._note_lineage(r.g)
         self._log("heal", r.g, dead=sorted(new_dead),
                   members=len(survivors))
         self._check("heal", r.g, graph=r.graph)
@@ -440,7 +496,11 @@ class SimFleet:
         """Adopt every committed epoch past mine.  Committed records
         are immutable, so the first prober's board read is shared
         fleet-wide (adopters only READ the record)."""
-        while self.transport.epoch_word > r.epoch and not r.exited:
+        # partition-aware read: a rank cut away from the board keeps
+        # seeing the epoch word frozen at the cut, so it can neither
+        # adopt nor be fenced by the far side's commits
+        while self.transport.epoch_word_seen(r.g) > r.epoch \
+                and not r.exited:
             rec = self._epoch_recs.get(r.epoch + 1)
             if rec is None:
                 rec = self.board.epoch_record(r.epoch + 1)
@@ -531,6 +591,7 @@ class SimFleet:
                               copy=True)
         rec = self.board.grant(r.g, live, Gg, [], True, r.epoch)
         if rec is not None:
+            self._note_lineage(r.g)
             self._log("grant", r.g, epoch=int(rec["epoch"]),
                       joined=list(rec["joined"]))
             self._journal(r.g, "join_admitted",
@@ -584,6 +645,141 @@ class SimFleet:
             self._check("join", j.g)
         return fire
 
+    # -- partition + orphan machinery -------------------------------------
+
+    def _enter_orphan(self, r: SimRank, live: int, total: int) -> None:
+        """The minority verdict: park the rank (rounds stop, shared
+        state untouched) until the partition heals and the merge event
+        re-admits it through the join machinery."""
+        if r.orphaned:
+            return
+        r.orphaned = True
+        self._journal(r.g, "orphan_entered", epoch=r.epoch,
+                      global_rank=r.g, live=live, total=total,
+                      floor=_quorum.majority_floor(total))
+        self._log("orphan", r.g, live=live, total=total)
+        self._check("orphan", r.g)
+
+    def _note_lineage(self, g: int) -> None:
+        """Record which partition side just committed membership
+        progress (heal / grant / reweight) — the single-lineage
+        invariant's feed.  A no-op outside a partition window."""
+        if self._partition is not None:
+            self._lineage.add(self._partition.get(int(g),
+                                                  self._board_group))
+
+    def _mass_anchor(self) -> Tuple[float, float]:
+        """The conserved quantity ``live + slots + inflight + lost -
+        joined`` — constant across every event, snapshotted at a cut
+        as the partition+merge conservation anchor."""
+        lx = math.fsum(r.x for r in self.ranks.values()
+                       if not r.killed and not r.exited)
+        lp = math.fsum(r.p for r in self.ranks.values()
+                       if not r.killed and not r.exited)
+        sx, sp = self.transport.slot_mass()
+        ix, ip = self.transport.inflight_mass()
+        return (lx + sx + ix + self.transport.lost_x - self.joined_x,
+                lp + sp + ip + self.transport.lost_p - self.joined_p)
+
+    def _partition_start_event(self, f: Fault):
+        def fire():
+            if self._partition is not None:
+                return  # one cut at a time
+            current = {g for g, r in self.ranks.items()
+                       if not r.killed and not r.exited}
+            groups: Dict[int, int] = {}
+            for i, side in enumerate(f.groups()):
+                for g in side:
+                    groups[int(g)] = i + 1
+            for g in current:
+                groups.setdefault(g, 0)  # the implicit "rest" side
+            # the board lives with the lowest live rank's side (the
+            # real board sits on the sponsor host's filesystem)
+            live_now = sorted(current)
+            board_group = groups.get(live_now[0], 0) if live_now else 0
+            self.transport.set_partition(groups, board_group)
+            self._partition = groups
+            self._board_group = board_group
+            self._lineage = set()
+            self._partition_anchor = self._mass_anchor()
+            self._log("partition_start", -1, groups=f.group,
+                      board_side=board_group)
+            self._check("partition_start", -1)
+        return fire
+
+    def _partition_end_event(self, f: Fault):
+        def fire():
+            if self._partition is None:
+                return
+            self.transport.clear_partition()
+            orphans = sorted(g for g, r in self.ranks.items()
+                             if r.orphaned and not r.killed
+                             and not r.exited)
+            self._log("partition_heal", -1, orphans=orphans)
+            self._partition = None
+            self._lineage = set()
+            # the anchor stays armed: the conserved quantity must
+            # still hold through every merge-back below
+            for g in orphans:
+                self.loop.after(0.0, self._merge_orphan_event(g))
+            self._check("partition_heal", -1)
+        return fire
+
+    def _merge_orphan_event(self, g: int):
+        def fire():
+            r = self.ranks.get(g)
+            if r is None or r.killed or r.exited or not r.orphaned:
+                return
+            est = r.estimate
+            carried = est if est == est else 0.0
+            # the old identity retires: survivors healed it out and
+            # adopted its ledger, so its stale mass is written off and
+            # it re-enters below with unit mass at its carried
+            # (debiased) estimate — mirroring islands.merge_orphan
+            self.transport.adopted_ranks.add(g)
+            self.transport.lost_x += r.x
+            self.transport.lost_p += r.p
+            r.x = 0.0
+            r.p = 0.0
+            r.exited = True
+            self._log("merge_post", g, carried=round(carried, 9))
+            self._check("merge_post", g)
+            req = self.board.post_request()
+            try:
+                grant = self.board.wait_for_grant(
+                    req, timeout=self.cfg.join_timeout_s)
+            except TimeoutError:
+                # nobody left to sponsor (e.g. an even split orphaned
+                # everyone): the rank stays fenced, mass written off
+                self._log("merge_timeout", g, req=req)
+                self._check("merge_timeout", g)
+                return
+            rec = grant.record
+            j = SimRank(grant.rank, x=carried, p=1.0)
+            self.joined_x += j.x
+            self.joined_p += j.p
+            j.epoch = int(rec["epoch"])
+            j.epoch_members = j.members = tuple(
+                int(m) for m in rec["members"])
+            ekey = ("rec", j.epoch)
+            j.graph = self._topo_entry(ekey, lambda: record_graph(rec))
+            j.cfg_key = j.base_key = ekey
+            self.ranks[j.g] = j
+            self._wire_rank(j)
+            self.orphans_merged += 1
+            self._journal(j.g, "orphan_merged", old_rank=g,
+                          new_rank=j.g, epoch=j.epoch,
+                          carried_estimate=carried)
+            self._log("merge_enter", j.g, epoch=j.epoch, old=g,
+                      sponsor=int(rec["sponsor"]))
+            off = (j.g * 37 % 101) / 101.0
+            self.loop.after(off * self.cfg.hb_interval,
+                            self._hb_event(j.g))
+            self.loop.after(off * self.cfg.round_period,
+                            self._round_event(j.g))
+            self._check("merge", j.g)
+        return fire
+
     # -- adaptive demote/promote ------------------------------------------
 
     def _adaptive_step(self, r: SimRank) -> None:
@@ -622,6 +818,8 @@ class SimFleet:
 
     def _commit_reweight(self, r: SimRank, demote_set: Set[int],
                          promoted: Tuple[int, ...]) -> None:
+        if not self.transport.board_reachable(r.g):
+            return  # cut away from the board: the commit would stall
         base_graph = self._graph_of(r.base_key)
         demote_local = sorted(r.members.index(d) for d in demote_set
                               if d in r.members)
@@ -644,6 +842,7 @@ class SimFleet:
         if rec is not None and rec.get("reweight") \
                 and int(rec["sponsor"]) == r.g \
                 and int(rec["epoch"]) == r.epoch + 1:
+            self._note_lineage(r.g)
             kind = "promote_commit" if promoted else "demote_commit"
             self._log(kind, r.g, epoch=int(rec["epoch"]),
                       demoted=sorted(demote_set),
@@ -754,6 +953,18 @@ class SimFleet:
         if err:
             self._violate("epoch-monotone", f"at {point}: {err}", g)
         self._epoch_word_seen = max(self._epoch_word_seen, word)
+        # partition-window invariants (standing: audited after every
+        # event, like the rest — they just only arm once a cut lands)
+        if self._lineage:
+            err = _inv.check_single_lineage(self._lineage)
+            if err:
+                self._violate("single-lineage", f"at {point}: {err}", g)
+        if self._partition_anchor is not None:
+            err = _inv.check_partition_merge_mass(
+                self._partition_anchor, self._mass_anchor(),
+                tol=self.cfg.mass_tol)
+            if err:
+                self._violate("partition-mass", f"at {point}: {err}", g)
         if graph is not None and id(graph) not in self._graphs_ok:
             err = _inv.check_doubly_stochastic(graph)
             if err:
@@ -831,7 +1042,11 @@ class SimFleet:
                  if not r.killed and not r.exited]
         if not alive:
             return set()
-        top = max(alive, key=lambda r: (r.epoch, -r.g))
+        # an orphan's member view is frozen pre-cut — never let it
+        # define the fleet (a still-parked orphan at quiesce is fenced,
+        # not consulted)
+        pool = [r for r in alive if not r.orphaned] or alive
+        top = max(pool, key=lambda r: (r.epoch, -r.g))
         view = set(top.members) - self.transport.adopted_ranks \
             - self.transport.killed
         return {g for g in view

@@ -29,6 +29,8 @@ __all__ = [
     "check_epoch_monotone",
     "check_minority_demotion",
     "check_consensus",
+    "check_single_lineage",
+    "check_partition_merge_mass",
     "demotion_cap",
 ]
 
@@ -124,6 +126,41 @@ def check_minority_demotion(n_members: int,
         return (f"{n_demoted} of {n_members} members demoted — over the "
                 f"minority cap {demotion_cap(n_members)} (the healthy "
                 "majority must keep carrying the gossip)")
+    return None
+
+
+def check_single_lineage(committed_groups) -> Optional[str]:
+    """At most ONE side of an active partition may commit membership
+    progress (heal, demote/promote, grant) — the split-brain fence.
+    ``committed_groups`` is the set of partition-group ids that
+    committed during the current window; two or more means both sides
+    advanced their own epoch lineage, and their ledgers have already
+    diverged."""
+    gs = sorted({int(g) for g in committed_groups})
+    if len(gs) > 1:
+        return (f"split-brain: partition sides {gs} each committed "
+                "membership progress during one partition window — at "
+                "most one epoch lineage may advance (the minority must "
+                "ORPHAN and quiesce)")
+    return None
+
+
+def check_partition_merge_mass(anchor: Tuple[float, float],
+                               current: Tuple[float, float],
+                               tol: float = 1e-8) -> Optional[str]:
+    """Mass is conserved ACROSS a partition + merge: the conserved
+    quantity ``live + slots + inflight + lost - joined`` snapshotted
+    when the cut landed (``anchor``) must still hold after every event
+    of the window and the merge-back — an orphan whose old mass is not
+    written off when it re-enters with unit mass shows up here as a
+    double count."""
+    dx = abs(current[0] - anchor[0]) / max(1.0, abs(anchor[0]))
+    dp = abs(current[1] - anchor[1]) / max(1.0, abs(anchor[1]))
+    if dx > tol or dp > tol:
+        return (f"mass not conserved across partition+merge: x residual "
+                f"{current[0] - anchor[0]:.3e} vs the cut-time anchor "
+                f"{anchor[0]:.6g}, p residual {current[1] - anchor[1]:.3e}"
+                f" vs {anchor[1]:.6g}")
     return None
 
 

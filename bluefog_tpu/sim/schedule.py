@@ -23,7 +23,18 @@ slow   main-thread sleep per step from ``step`` until ``stop``
 join   a joiner posts on the membership board at step
        (``BFTPU_CHAOS_JOIN_RANK``, ``BFTPU_CHAOS_JOIN_STEP``) / a
        fresh SimRank rendezvouses
+partition network partition from ``step`` until ``stop``: cross-group
+       traffic drops, liveness words and the membership-epoch word go
+       stale across the cut (``BFTPU_CHAOS_PARTITION_GROUP``,
+       ``BFTPU_CHAOS_PARTITION_STEP``, ``BFTPU_CHAOS_PARTITION_STOP``)
+       / the quorum-fenced minority ORPHANs and merges back on heal
 ====== ==========================================================
+
+A partition's sides ride in ``group``: a pipe-separated list of
+comma-separated global ranks (``"3"`` = rank 3 vs everyone else;
+``"0,1|6,7"`` = two explicit islands plus the implicit rest).  Ranks
+not named in any group form one extra implicit group, so the compact
+one-sided spelling shrinks well under ddmin.
 
 ``to_json``/``from_json`` round-trip losslessly.  ``to_env`` projects
 onto the chaos env keys — which hold at most ONE schedule per kind
@@ -50,25 +61,31 @@ from bluefog_tpu.resilience import chaos as _chaos
 __all__ = ["Fault", "FaultSchedule", "SCHEDULE_SCHEMA", "FAULT_KINDS"]
 
 SCHEDULE_SCHEMA = "bftpu-fault-schedule/1"
-FAULT_KINDS = ("kill", "suspend", "slow", "join")
+FAULT_KINDS = ("kill", "suspend", "slow", "join", "partition")
 
 
 @dataclasses.dataclass(frozen=True, order=True)
 class Fault:
     """One scheduled fault.  Ordering is ``(step, kind, rank)`` so a
     sorted schedule is canonical — two schedules with the same fault
-    set serialize identically."""
+    set serialize identically.  ``group`` is the partition-side spec
+    (empty for every other kind) and rides LAST so pre-partition
+    schedules order, construct, and serialize exactly as before."""
 
     step: int
     kind: str
     rank: int
     duration_s: float = 0.0
     stop: Optional[int] = None
+    group: str = ""
 
     def __post_init__(self):
         if self.kind not in FAULT_KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r} "
                              f"(one of {FAULT_KINDS})")
+        if self.kind == "partition" and not self.group:
+            raise ValueError("partition fault needs a group spec "
+                             "(e.g. '3' or '0,1|6,7')")
 
     def to_dict(self) -> dict:
         d = {"kind": self.kind, "step": int(self.step),
@@ -77,6 +94,8 @@ class Fault:
             d["duration_s"] = float(self.duration_s)
         if self.stop is not None:
             d["stop"] = int(self.stop)
+        if self.group:
+            d["group"] = str(self.group)
         return d
 
     @classmethod
@@ -85,7 +104,27 @@ class Fault:
                    rank=int(d["rank"]),
                    duration_s=float(d.get("duration_s", 0.0)),
                    stop=(None if d.get("stop") is None
-                         else int(d["stop"])))
+                         else int(d["stop"])),
+                   group=str(d.get("group", "")))
+
+    @classmethod
+    def partition(cls, groups, start: int, stop: int) -> "Fault":
+        """The ``partition(groups, t0, t1)`` constructor: cross-group
+        traffic drops from round ``start`` until round ``stop``.
+        ``groups`` is an iterable of rank iterables; ranks named in no
+        group form one implicit extra side."""
+        spec = "|".join(",".join(str(int(r)) for r in sorted(grp))
+                        for grp in groups)
+        return cls(kind="partition", step=int(start), rank=-1,
+                   stop=int(stop), group=spec)
+
+    def groups(self) -> Tuple[Tuple[int, ...], ...]:
+        """Parse the ``group`` spec into explicit rank tuples (the
+        implicit "rest" side is the fleet's to derive — it knows who is
+        alive when the cut lands)."""
+        return tuple(
+            tuple(sorted(int(x) for x in part.split(",") if x.strip()))
+            for part in self.group.split("|") if part.strip())
 
 
 class FaultSchedule:
@@ -161,6 +200,9 @@ class FaultSchedule:
                                      stop=f.stop)
             elif kind == "join":
                 _chaos.schedule_join(env, f.rank, f.step)
+            elif kind == "partition":
+                _chaos.schedule_partition(env, f.group, f.step,
+                                          stop=f.stop)
         return env
 
     @classmethod
@@ -189,6 +231,13 @@ class FaultSchedule:
             faults.append(Fault(
                 kind="join", rank=int(env[_chaos._JOIN_RANK]),
                 step=int(env.get(_chaos._JOIN_STEP, "1"))))
+        if _chaos._PARTITION_GROUP in env:
+            stop = env.get(_chaos._PARTITION_STOP)
+            faults.append(Fault(
+                kind="partition", rank=-1,
+                step=int(env.get(_chaos._PARTITION_STEP, "1")),
+                stop=None if stop is None else int(stop),
+                group=str(env[_chaos._PARTITION_GROUP])))
         return cls(faults)
 
     # -- seeded generation -------------------------------------------------
@@ -215,11 +264,25 @@ class FaultSchedule:
         faults: List[Fault] = []
         kills = 0
         victims = set()
+        partitions = 0
         for _ in range(int(n_faults)):
             kind = rng.choice(kinds)
             if kind == "kill" and kills >= max_kills:
                 kind = "slow" if "slow" in kinds else "join"
             step = rng.randrange(1, horizon + 1)
+            if kind == "partition":
+                # one window at a time (the fleet runs one cut), the
+                # named side strictly sub-majority so the implicit rest
+                # keeps quorum and can sponsor the merge-back
+                if partitions >= 1:
+                    continue
+                partitions += 1
+                size = rng.randrange(1, max(2, min(ranks // 4,
+                                                   (ranks - 1) // 2) + 1))
+                side = sorted(rng.sample(range(ranks), size))
+                stop = min(rounds, step + rng.randrange(4, 10))
+                faults.append(Fault.partition([side], step, stop))
+                continue
             # victims are distinct (two faults on one rank is a valid
             # scenario but shrinks poorly: keep campaigns orthogonal)
             pool = [r for r in range(ranks) if r not in victims]

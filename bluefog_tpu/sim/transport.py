@@ -79,7 +79,10 @@ class SimJobView:
         self._t.beat(self._g)
 
     def liveness(self, rank: int) -> float:
-        return self._t.liveness(self._members[int(rank)])
+        # partition-aware: across an active cut the reader keeps
+        # seeing the stamp frozen at the cut, so its detector times
+        # the far side out exactly like a crash
+        return self._t.liveness_seen(self._g, self._members[int(rank)])
 
 
 class SimTransport:
@@ -118,6 +121,68 @@ class SimTransport:
         self.lost_p = 0.0
         # mutexes: key -> (holder, acquired_at)
         self._mutex: Dict[object, Tuple[object, float]] = {}
+        # network partition: global rank -> group id while a cut is
+        # active (None = fully connected).  Liveness words and the
+        # epoch word freeze ACROSS the cut (the snapshots below are
+        # what the far side keeps reading), and cross-group deliveries
+        # drop to the lost bucket — a partition severs traffic, it
+        # does not destroy state.
+        self._partition_groups: Optional[Dict[int, int]] = None
+        self._board_group = 0
+        self._frozen_liveness: Dict[int, float] = {}
+        self._frozen_epoch_word = 0
+
+    # -- network partition -------------------------------------------------
+
+    def set_partition(self, groups: Dict[int, int],
+                      board_group: int) -> None:
+        """Cut the network along ``groups`` (a COMPLETE global-rank ->
+        group-id map; unknown ranks — e.g. a joiner spawned mid-cut —
+        land with the board).  ``board_group`` names the side the
+        membership board lives on: everyone else sees the epoch word
+        frozen and their board ops stall, exactly like an unreachable
+        filesystem."""
+        self._partition_groups = {int(g): int(i)
+                                  for g, i in groups.items()}
+        self._board_group = int(board_group)
+        self._frozen_liveness = dict(self._liveness)
+        self._frozen_epoch_word = self.epoch_word
+
+    def clear_partition(self) -> None:
+        self._partition_groups = None
+        self._frozen_liveness = {}
+
+    @property
+    def partitioned(self) -> bool:
+        return self._partition_groups is not None
+
+    def _group_of(self, g: int) -> int:
+        assert self._partition_groups is not None
+        return self._partition_groups.get(int(g), self._board_group)
+
+    def _crosses(self, a: int, b: int) -> bool:
+        return (self._partition_groups is not None
+                and self._group_of(a) != self._group_of(b))
+
+    def liveness_seen(self, reader: int, g: int) -> float:
+        """The liveness stamp ``reader`` observes for ``g``: the live
+        word, unless a partition separates them — then the stamp frozen
+        at the cut (the far side looks like it stopped beating)."""
+        if self._crosses(reader, g):
+            return self._frozen_liveness.get(int(g), 0.0)
+        return self.liveness(g)
+
+    def epoch_word_seen(self, reader: int) -> int:
+        """The membership-epoch word ``reader`` observes: frozen at the
+        cut for ranks partitioned away from the board."""
+        if (self._partition_groups is not None
+                and self._group_of(reader) != self._board_group):
+            return self._frozen_epoch_word
+        return self.epoch_word
+
+    def board_reachable(self, g: int) -> bool:
+        return (self._partition_groups is None
+                or self._group_of(g) == self._board_group)
 
     # -- liveness words ----------------------------------------------------
 
@@ -154,7 +219,11 @@ class SimTransport:
         def _deliver():
             mx, mp = self._inflight.pop(mid)
             if (s_ in self.killed or d_ in self.killed
-                    or (ep, d_) in self._retired):
+                    or (ep, d_) in self._retired
+                    # a delivery caught crossing an active cut drops —
+                    # the mass leaves live circulation (lost bucket),
+                    # never silently evaporates
+                    or self._crosses(s_, d_)):
                 self.lost_x += mx
                 self.lost_p += mp
                 return
@@ -410,7 +479,7 @@ class SimBoard(MembershipBoard):
     def _publish_epoch_word(self, epoch: int) -> None:
         self._transport.epoch_word = int(epoch)
 
-    def post_request(self) -> str:
+    def post_request(self, retiring: int = -1) -> str:
         """Deterministic request ids (the real board's
         hostname-pid-uuid ids would break bit-identical replay)."""
         self._req_seq += 1
@@ -421,8 +490,10 @@ class SimBoard(MembershipBoard):
                 raise RuntimeError(
                     f"no membership board for job {self.job!r} — is the "
                     "fleet initialized (SimFleet publishes the board)?")
-            doc["requests"].append({"req": req_id, "pid": self._req_seq,
-                                    "host": "sim",
-                                    "t": self._clock.now()})
+            req = {"req": req_id, "pid": self._req_seq,
+                   "host": "sim", "t": self._clock.now()}
+            if int(retiring) >= 0:
+                req["retiring"] = int(retiring)
+            doc["requests"].append(req)
             self._publish(doc)
         return req_id
