@@ -573,6 +573,21 @@ def main():
             print(json.dumps(tcpf), file=sys.stderr)
         except Exception as e:  # noqa: BLE001
             print(f"tcp chunked-framing phase failed: {e!r}", file=sys.stderr)
+    sps = srate = None
+    if time.perf_counter() - t_start < budget_s:
+        try:
+            # serving headline (docs/SERVING.md): publisher commits
+            # versioned snapshots into the double-buffered seqlock'd
+            # region while a replica process subscribes; median
+            # publish-complete to hot-swap-complete latency, plus the
+            # decoupled steady-state serve rate
+            from serving import measure_publish_swap, measure_serve_rate
+            sps = measure_publish_swap()
+            print(json.dumps(sps), file=sys.stderr)
+            srate = measure_serve_rate()
+            print(json.dumps(srate), file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"serving phase failed: {e!r}", file=sys.stderr)
     wcr = None
     if time.perf_counter() - t_start < budget_s:
         try:
@@ -709,6 +724,15 @@ def main():
         # 0.22 GB/s pre-chunking baseline, not this number — see
         # docs/STATUS.md round 15)
         headline["tcp_legacy_gbps"] = tcpf["legacy_gbs"]
+    if sps is not None:
+        headline["publish_swap_ms"] = sps["value"]
+        headline["publish_swap_metric"] = sps["metric"]
+        # the subscribe floor: publish_swap_ms minus the replica's poll
+        # cadence is region read + crc + the reference flip
+        headline["publish_swap_poll_ms"] = sps["replica_poll_ms"]
+    if srate is not None:
+        headline["serve_rate_steps_s"] = srate["value"]
+        headline["serve_rate_metric"] = srate["metric"]
     if wcr is not None:
         headline["wire_compression_ratio"] = wcr["value"]
         headline["wire_compression_metric"] = wcr["metric"]

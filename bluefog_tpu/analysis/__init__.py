@@ -57,6 +57,14 @@ consumers (CLI, pytest, CI):
   to consensus with a balanced ledger, and the seeded ``split_brain``
   bug is caught by the single-lineage invariant and ddmin-shrinks to
   the partition fault alone;
+- **serve** (:mod:`.serve_rules`) — the serving plane: pinned serve
+  campaigns (replica killed mid-swap and respawned, publisher killed
+  mid-payload and mid-flip) publish strictly monotone versions with
+  replicas converging to the committed head, the publish fence is
+  pinned against the production quorum arithmetic with an
+  orphaned-publisher campaign showing the handoff, and an exhaustive
+  double-buffer interleaving model proves a completed read only ever
+  returns a committed version's canonical bytes;
 - **lab** (:mod:`.lab_rules`) — the convergence observatory's frozen
   sweep artifact: schema-valid, cell fits refittable from their own
   series, scaling laws non-increasing in fleet size, measured rates
@@ -94,6 +102,7 @@ from bluefog_tpu.analysis import (  # noqa: F401
     progress_rules,
     resilience_rules,
     seqlock_model,
+    serve_rules,
     sim_rules,
     telemetry_rules,
     trace_rules,

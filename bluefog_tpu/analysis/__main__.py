@@ -125,6 +125,24 @@ def main(argv=None) -> int:
             print(f"self-test FAILED: partition campaign(s) failed "
                   f"{torn}")
             return 1
+        # serve arm: acceptance-size serve campaigns under chaos must
+        # publish monotone, converge replicas, and replay bit-identically
+        from bluefog_tpu.analysis import serve_rules
+
+        stale = []
+        for label, res, findings in (
+                serve_rules.selftest_serve_campaigns()):
+            ok = not findings
+            print(f"  {label:<36s} "
+                  f"{'clean' if ok else 'VIOLATED'} "
+                  f"(events={res.events}, digest={res.digest[:12]})")
+            for f in findings:
+                print(f"    {f}")
+            if not ok:
+                stale.append(label)
+        if stale:
+            print(f"self-test FAILED: serve campaign(s) failed {stale}")
+            return 1
         # lab arm: every claim the frozen sweep artifact makes must
         # re-derive from its own raw data (python -m bluefog_tpu.lab
         # --check runs the same checks standalone)
@@ -157,7 +175,8 @@ def main(argv=None) -> int:
         print(f"self-test OK: all {len(fixtures.FIXTURES)} seeded bugs "
               f"caught, {len(sim_rules.SELFTEST_PINS)} pinned campaigns "
               f"+ {len(partition_rules.PARTITION_PINS)} partition "
-              f"campaigns clean, lab artifact verified ({ncells} cells)")
+              f"+ {len(serve_rules.SERVE_PINS)} serve campaigns clean, "
+              f"lab artifact verified ({ncells} cells)")
         return 0
 
     families = args.families

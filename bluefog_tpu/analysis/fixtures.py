@@ -573,6 +573,44 @@ def _sim_split_brain() -> List[Finding]:
     return sim_rules.campaign_findings(res, "fixture[sim-split-brain]")
 
 
+def _serve_version_reset() -> List[Finding]:
+    """A serve campaign whose publisher handoff forgets the region
+    header's persisted version word and restarts at 1
+    (``serve_version_reset``): the serve-monotone standing invariant
+    must flag it at the publisher."""
+    from bluefog_tpu.analysis import serve_rules, sim_rules
+
+    _cfg, _sched, res = serve_rules.serve_campaign(
+        16, 24, 3, debug_bugs=("serve_version_reset",))
+    return sim_rules.campaign_findings(res,
+                                       "fixture[serve-version-reset]")
+
+
+def _serve_torn_swap() -> List[Finding]:
+    """A serve campaign whose replica swap mixes old and new buffer
+    bytes instead of flipping one whole generation (``serve_torn``):
+    the serve-committed standing invariant must flag bytes that match
+    no committed snapshot."""
+    from bluefog_tpu.analysis import serve_rules, sim_rules
+
+    _cfg, _sched, res = serve_rules.serve_campaign(
+        16, 24, 3, debug_bugs=("serve_torn",))
+    return sim_rules.campaign_findings(res, "fixture[serve-torn-swap]")
+
+
+def _serve_torn_read_model() -> List[Finding]:
+    """The double-buffer interleaving model with both seqlocks dropped:
+    a reader racing the buffer-reuse publish completes with a torn mix
+    of two generations, which the model must surface."""
+    from bluefog_tpu.analysis import serve_rules
+
+    res = serve_rules.torn_read_model(buffer_seqlock=False,
+                                      header_seqlock=False)
+    return [Finding("serve.torn-read-model",
+                    "fixture[serve-no-seqlock]", msg)
+            for msg in res["findings"]]
+
+
 # ---------------------------------------------------------------------------
 # lab fixtures: mutate the REAL frozen sweep artifact (same rationale as
 # the plan fixtures — a schema change that disarms a rule breaks these)
@@ -705,6 +743,11 @@ FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
     "sim-mass-leak": _sim_mass_leak,
     "sim-cap-bypass": _sim_cap_bypass,
     "sim-split-brain": _sim_split_brain,
+    # serve family: a forgetful publisher handoff, a torn replica
+    # swap, and the double-buffer model with its seqlocks dropped
+    "serve-version-reset": _serve_version_reset,
+    "serve-torn-swap": _serve_torn_swap,
+    "serve-torn-read-model": _serve_torn_read_model,
     # lab family: tampered sweep artifacts the observatory must reject
     "lab-corrupted-fit": _lab_corrupted_fit,
     "lab-tampered-rate": _lab_tampered_rate,

@@ -92,7 +92,7 @@ def render(snap: dict, rates: Dict[int, float]) -> str:
     lines.append("")
     lines.append(f"{'RANK':>4} {'STEP':>8} {'STEP/S':>7} {'EPOCH':>5} "
                  f"{'LAST OP':<12} {'BALANCE':>10} {'CONV':>9} "
-                 f"{'QUEUE':<14} {'HOLDS':<8} EDGES")
+                 f"{'SERVE':>9} {'QUEUE':<14} {'HOLDS':<8} EDGES")
     for r in ranks:
         page = snap["ranks"][str(r)]
         if "error" in page:
@@ -115,6 +115,13 @@ def render(snap: dict, rates: Dict[int, float]) -> str:
         conv = page.get("conv", {})
         cerr, cround = conv.get("err", -1.0), conv.get("round", -1)
         conv_s = f"{cerr:.1e}" if cround >= 0 and cerr >= 0.0 else "—"
+        # serving plane (statuspage v5): the snapshot version this rank
+        # publishes/serves; replicas append their lag ("v3+2" = serving
+        # v3, 2 committed versions behind); "—" = not a serve rank
+        sv = page.get("serve", {})
+        sver, slag = sv.get("version", -1), sv.get("lag", -1)
+        serve_s = "—" if sver < 0 else (
+            f"v{sver}" + (f"+{slag}" if slag > 0 else ""))
         # an ORPHAN rank quiesced on quorum loss — the page freezes at
         # the denial, so the state outranks whatever op came last
         last_op = "ORPHAN" if page.get("orphan") else page["last_op"]
@@ -123,7 +130,14 @@ def render(snap: dict, rates: Dict[int, float]) -> str:
             f"{('%.1f' % rate) if rate is not None else '—':>7} "
             f"{page['epoch']:>5} {last_op:<12} "
             f"{page['ledger']['balance']:>10.3g} {conv_s:>9} "
-            f"{queue:<14} {holds:<8} {edges}")
+            f"{serve_s:>9} {queue:<14} {holds:<8} {edges}")
+    if snap.get("serve"):
+        lines.append("")
+        lines.append(
+            f"serving: committed v{snap.get('serve_published', -1)}; " +
+            ", ".join(f"r{r} v{v['version']} lag {max(0, v['lag'])}"
+                      for r, v in sorted(snap["serve"].items(),
+                                         key=lambda kv: int(kv[0]))))
     if snap.get("orphans"):
         lines.append("")
         lines.append(f"ORPHANED (quorum lost, quiesced): "

@@ -29,9 +29,9 @@ from typing import Dict, List, Optional, Tuple
 
 from bluefog_tpu.native import shm_native
 
-STATUS_SCHEMA = "bftpu-statuspage/4"
+STATUS_SCHEMA = "bftpu-statuspage/5"
 STATUS_MAGIC = 0x42465350  # "BFSP"
-STATUS_VERSION = 4
+STATUS_VERSION = 5
 
 #: Page layout: header (magic u32, version u32, seq u64), fixed block,
 #: then up to MAX_EDGES edge records; the whole page is padded to
@@ -40,8 +40,12 @@ STATUS_VERSION = 4
 #: the fixed block; v3 appends the convergence-probe word (consensus
 #: error + probe round); v4 appends the flags word (bit 0 = ORPHAN:
 #: this rank lost membership quorum and quiesced — see
-#: docs/RESILIENCE.md "Orphan quiesce").  Readers still decode
-#: v1/v2/v3 pages from live older writers.
+#: docs/RESILIENCE.md "Orphan quiesce"); v5 appends the serving plane
+#: (serve_version + serve_lag — the snapshot version a publisher last
+#: committed / a replica currently serves, and how many committed
+#: versions the replica trails; -1/-1 = not part of the serve plane,
+#: see docs/SERVING.md).  Readers still decode v1..v4 pages from live
+#: older writers.
 _HEAD = struct.Struct("<IIQ")                 # magic, version, seq
 _FIXED_V1 = struct.Struct("<iiiiQQQdd16sdddd")  # rank, nranks, pid, n_edges,
 #                                                 step, epoch, op_id,
@@ -50,7 +54,9 @@ _FIXED_V1 = struct.Struct("<iiiiQQQdd16sdddd")  # rank, nranks, pid, n_edges,
 _FIXED_V2 = struct.Struct("<iiiiQQQdd16sddddi16s")  # ... + qdepth, inflight
 _FIXED_V3 = struct.Struct("<iiiiQQQdd16sddddi16sdq")  # ... + conv_err,
 #                                                         conv_round
-_FIXED = struct.Struct("<iiiiQQQdd16sddddi16sdqi")    # ... + flags
+_FIXED_V4 = struct.Struct("<iiiiQQQdd16sddddi16sdqi")  # ... + flags
+_FIXED = struct.Struct("<iiiiQQQdd16sddddi16sdqiqq")   # ... + serve_version,
+#                                                          serve_lag
 _EDGE = struct.Struct("<iid")                 # peer_global, state, deadline_s
 MAX_EDGES = 32
 PAGE_BYTES = 1024
@@ -94,7 +100,8 @@ class StatusPage:
                 last_op: str = "", ledger: Optional[Dict[str, float]] = None,
                 edges=(), qdepth: int = -1, inflight: str = "",
                 conv_err: float = -1.0, conv_round: int = -1,
-                flags: int = 0) -> None:
+                flags: int = 0, serve_version: int = -1,
+                serve_lag: int = -1) -> None:
         """Seqlocked single-writer update of the whole page.
 
         ``edges`` is an iterable of ``(peer_global, state_code,
@@ -103,7 +110,9 @@ class StatusPage:
         ``qdepth``/``inflight`` mirror the rank's progress engine
         (-1 = no engine running); ``conv_err``/``conv_round`` mirror
         the convergence probe (round -1 = probe off); ``flags`` is the
-        v4 bit set (``FLAG_ORPHAN`` = quorum lost, rank quiesced)."""
+        v4 bit set (``FLAG_ORPHAN`` = quorum lost, rank quiesced);
+        ``serve_version``/``serve_lag`` are the v5 serving plane
+        (-1 = this rank neither publishes nor serves snapshots)."""
         mm = self._seg._mm
         led = ledger or {}
         ed = list(edges)[:MAX_EDGES]
@@ -121,7 +130,8 @@ class StatusPage:
             float(led.get("drained", 0.0)), float(led.get("pending", 0.0)),
             int(qdepth),
             str(inflight).encode("utf-8", "replace")[:16],
-            float(conv_err), int(conv_round), int(flags))
+            float(conv_err), int(conv_round), int(flags),
+            int(serve_version), int(serve_lag))
         off = _HEAD.size + _FIXED.size
         for peer, state, deadline in ed:
             _EDGE.pack_into(mm, off, int(peer), int(state), float(deadline))
@@ -137,7 +147,7 @@ def _decode(buf: bytes) -> Dict[str, object]:
     magic, version, seq = _HEAD.unpack_from(buf, 0)
     if magic != STATUS_MAGIC:
         raise ValueError(f"not a status page (magic 0x{magic:08x})")
-    if version not in (1, 2, 3, STATUS_VERSION):
+    if version not in (1, 2, 3, 4, STATUS_VERSION):
         raise ValueError(f"unsupported status-page version {version}")
     if version == 1:
         # a live v1 writer (mid-upgrade fleet): no progress-engine block
@@ -147,6 +157,7 @@ def _decode(buf: bytes) -> Dict[str, object]:
         qdepth, inflight = -1, b""
         conv_err, conv_round = -1.0, -1
         flags = 0
+        serve_version, serve_lag = -1, -1
         fixed_size = _FIXED_V1.size
     elif version == 2:
         # a live v2 writer: progress block, no convergence word
@@ -155,6 +166,7 @@ def _decode(buf: bytes) -> Dict[str, object]:
             _FIXED_V2.unpack_from(buf, _HEAD.size)
         conv_err, conv_round = -1.0, -1
         flags = 0
+        serve_version, serve_lag = -1, -1
         fixed_size = _FIXED_V2.size
     elif version == 3:
         # a live v3 writer: convergence word, no flags word
@@ -162,11 +174,21 @@ def _decode(buf: bytes) -> Dict[str, object]:
          last_op, dep, col, drn, pend, qdepth, inflight,
          conv_err, conv_round) = _FIXED_V3.unpack_from(buf, _HEAD.size)
         flags = 0
+        serve_version, serve_lag = -1, -1
         fixed_size = _FIXED_V3.size
+    elif version == 4:
+        # a live v4 writer: flags word, no serving plane
+        (rank, nranks, pid, n_edges, step, epoch, op_id, wall_ts, mono_ts,
+         last_op, dep, col, drn, pend, qdepth, inflight,
+         conv_err, conv_round, flags) = _FIXED_V4.unpack_from(
+            buf, _HEAD.size)
+        serve_version, serve_lag = -1, -1
+        fixed_size = _FIXED_V4.size
     else:
         (rank, nranks, pid, n_edges, step, epoch, op_id, wall_ts, mono_ts,
          last_op, dep, col, drn, pend, qdepth, inflight,
-         conv_err, conv_round, flags) = _FIXED.unpack_from(buf, _HEAD.size)
+         conv_err, conv_round, flags,
+         serve_version, serve_lag) = _FIXED.unpack_from(buf, _HEAD.size)
         fixed_size = _FIXED.size
     edges: List[Dict[str, object]] = []
     off = _HEAD.size + fixed_size
@@ -214,6 +236,13 @@ def _decode(buf: bytes) -> Dict[str, object]:
         "flags": int(flags),
         # quorum-lost quiesce (docs/RESILIENCE.md "Orphan quiesce")
         "orphan": bool(int(flags) & FLAG_ORPHAN),
+        # the serving plane (docs/SERVING.md): a publisher's last
+        # committed version (lag 0) or a replica's served version and
+        # trail; version < 0 = this rank is not part of the serve plane
+        "serve": {
+            "version": int(serve_version),
+            "lag": int(serve_lag),
+        },
         "edges": edges,
     }
 
@@ -304,6 +333,11 @@ def collect(job: str) -> Dict[str, object]:
                        for e in p.get("edges", ())
                        if e.get("state") == "suspect"})
     orphans = sorted(r for r, p in fleet.items() if p.get("orphan"))
+    # the serving plane: every rank that publishes/serves snapshots
+    # (training publishers report lag 0; replicas their actual trail)
+    serve = {str(r): p["serve"] for r, p in sorted(fleet.items())
+             if "error" not in p
+             and p.get("serve", {}).get("version", -1) >= 0}
     return {
         "schema": "bftpu-top/1",
         "job": job,
@@ -313,6 +347,9 @@ def collect(job: str) -> Dict[str, object]:
         "holders": {str(m): h for m, h in sorted(holders.items())},
         "suspects": suspects,
         "orphans": orphans,
+        "serve": serve,
+        "serve_published": max(
+            (int(v["version"]) for v in serve.values()), default=-1),
     }
 
 

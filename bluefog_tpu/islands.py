@@ -109,6 +109,7 @@ __all__ = [
     "OrphanedError",
     "is_orphaned",
     "merge_orphan",
+    "serve_publish",
     "spawn",
 ]
 
@@ -246,6 +247,11 @@ class _IslandContext:
         # created lazily on the first *_async call so synchronous
         # programs never pay for the worker thread
         self.progress: Optional[_progress.ProgressEngine] = None
+        # serving plane (bluefog_tpu.serve): the snapshot region this
+        # rank publishes into (lazily created by serve_publish) and the
+        # last committed version, mirrored onto the v5 status page
+        self.serve_region = None
+        self.serve_version = -1
         if shm_native.statuspage_enabled():
             from bluefog_tpu.introspect import statuspage as _statuspage
 
@@ -398,6 +404,9 @@ def shutdown(unlink: bool = False) -> None:
     if ctx.statuspage is not None:
         ctx.statuspage.close(unlink=unlink)
         ctx.statuspage = None
+    if ctx.serve_region is not None:
+        ctx.serve_region.close(unlink=unlink)
+        ctx.serve_region = None
     hostmap = os.environ.get("BLUEFOG_ISLAND_HOSTMAP")
     if hostmap:
         from bluefog_tpu.native.routed_transport import parse_hostmap
@@ -1118,6 +1127,66 @@ def merge_orphan(timeout: Optional[float] = None):
 
 
 # ---------------------------------------------------------------------------
+# serving plane: fenced snapshot publication to the inference fleet
+# (bluefog_tpu.serve; docs/SERVING.md)
+# ---------------------------------------------------------------------------
+
+
+def serve_publish(name: str, payload_cap: Optional[int] = None) -> int:
+    """Publish my debiased estimate of window ``name`` as one committed
+    serve snapshot for the job's replica fleet (docs/SERVING.md).
+
+    The fence, in order: an ORPHAN quiesce raises immediately, and the
+    quorum gate re-checks the current live view (detector verdict) at
+    the publish boundary — a minority that has not yet healed enters the
+    orphan quiesce HERE instead of publishing a split-brain snapshot.
+    The progress engine (when running) is quiesced around the estimate
+    read so no async deposit lands mid-snapshot; the snapshot itself is
+    the push-sum debiased value x̂ = x/p — what the consensus agrees
+    on — stamped with the membership epoch, so the publish is fenced at
+    the epoch boundary replicas can reason about.
+
+    Returns the committed version — strictly monotone for the job, even
+    across publisher death and handoff (the region persists the word)."""
+    from bluefog_tpu.serve.snapshot import SnapshotRegion
+
+    ctx = _ctx()
+    _orphan_guard(ctx, "serve_publish")
+    if not _quorum_gate(ctx, set(ctx.detector.dead_ranks()),
+                        "serve_publish"):
+        _orphan_guard(ctx, "serve_publish")  # just quiesced: raise
+    win = _win(name)
+    reg = _telemetry.get_registry()
+    t0 = time.monotonic()
+    eng = ctx.progress
+    if eng is not None:
+        eng.quiesce()
+    try:
+        if ctx.associated_p and win.p_self > 0.0:
+            est = np.asarray(win.self_tensor) / win.p_self
+        else:
+            est = np.array(win.self_tensor, copy=True)
+    finally:
+        if eng is not None:
+            eng.resume()
+    region = ctx.serve_region
+    if region is None:
+        cap = int(payload_cap) if payload_cap else max(1, est.nbytes)
+        region = ctx.serve_region = SnapshotRegion(ctx.base_job, cap)
+    version = region.publish(est, epoch=ctx.epoch, step=ctx.op_rounds)
+    ctx.serve_version = version
+    if reg.enabled:
+        reg.counter("serve.published").inc()
+        reg.gauge("serve.version").set(version)
+        reg.histogram("serve.publish_s").observe(time.monotonic() - t0)
+        reg.journal("serve_publish", win=name, version=version,
+                    epoch=ctx.epoch, step=ctx.op_rounds,
+                    nbytes=int(est.nbytes))
+    _statuspage_tick(ctx, name, "serve_pub")
+    return version
+
+
+# ---------------------------------------------------------------------------
 # adaptive topology: the straggler demote/promote control loop
 # (resilience/adaptive.py; docs/RESILIENCE.md "Adaptive topology")
 # ---------------------------------------------------------------------------
@@ -1672,7 +1741,9 @@ def _statuspage_tick(ctx: "_IslandContext", name: str,
                      epoch=ctx.epoch, op_id=ctx.op_rounds,
                      last_op=f"{op}:{name}", ledger=ledger, edges=edges,
                      qdepth=qdepth, inflight=inflight,
-                     conv_err=ctx.conv_err, conv_round=ctx.conv_round)
+                     conv_err=ctx.conv_err, conv_round=ctx.conv_round,
+                     serve_version=ctx.serve_version,
+                     serve_lag=0 if ctx.serve_version >= 0 else -1)
     except (OSError, ValueError):
         pass  # a reaped segment must never fail the op itself
     if ctx.tracectl is not None:

@@ -56,6 +56,7 @@ Both transports are model-checked from one shared protocol spec by
 from __future__ import annotations
 
 import os
+import random
 import socket
 import struct
 import threading
@@ -161,14 +162,20 @@ def tcp_retries() -> int:
 
 
 def tcp_backoff_s() -> float:
-    """Base of the bounded exponential reconnect backoff
+    """Base of the bounded full-jitter reconnect backoff
     (``BFTPU_TCP_BACKOFF_S``, default 0.05): retry ``k`` sleeps
-    ``base * 2**k`` seconds, capped at 2 s per step."""
+    ``uniform(0, min(2.0, base * 2**k))`` seconds."""
     try:
         b = float(os.environ.get("BFTPU_TCP_BACKOFF_S", "0.05"))
     except ValueError:
         b = 0.05
     return max(b, 0.0)
+
+
+#: RNG behind the reconnect jitter — module-level so tests can pin it
+#: (``tcp_transport._jitter_rng = random.Random(seed)``) and so every
+#: connection in the process shares one stream
+_jitter_rng = random.Random()
 
 
 def tcp_chunked() -> bool:
@@ -826,8 +833,14 @@ class _Peers:
             pass
 
     def _backoff(self, rank: int, attempt: int, opname: str) -> None:
-        """One bounded-exponential backoff step before a reconnect."""
-        delay = min(tcp_backoff_s() * (2 ** attempt), 2.0)
+        """One bounded full-jitter backoff step before a reconnect.
+
+        Sampling ``uniform(0, min(cap, base * 2**attempt))`` instead of
+        sleeping the deterministic bound decorrelates a fleet that lost
+        the same peer at the same instant (publisher restart → every
+        replica reconnecting in lockstep, a thundering herd)."""
+        delay = _jitter_rng.uniform(
+            0.0, min(tcp_backoff_s() * (2 ** attempt), 2.0))
         reg = _telemetry.get_registry()
         if reg.enabled:
             reg.histogram("tcp.retry_backoff_s", op=opname).observe(delay)

@@ -50,6 +50,8 @@ __all__ = [
     "schedule_suspend",
     "schedule_slow",
     "schedule_partition",
+    "schedule_serve_kill",
+    "schedule_serve_pub_kill",
     "schedule_to_json",
     "apply_schedule_json",
     "clear_schedule",
@@ -72,12 +74,19 @@ _SLOW_STOP = "BFTPU_CHAOS_SLOW_STOP"
 _PARTITION_GROUP = "BFTPU_CHAOS_PARTITION_GROUP"
 _PARTITION_STEP = "BFTPU_CHAOS_PARTITION_STEP"
 _PARTITION_STOP = "BFTPU_CHAOS_PARTITION_STOP"
+_SERVE_KILL_REPLICA = "BFTPU_CHAOS_SERVE_KILL_REPLICA"
+_SERVE_KILL_SWAP = "BFTPU_CHAOS_SERVE_KILL_SWAP"
+_SERVE_KILL_STOP = "BFTPU_CHAOS_SERVE_KILL_STOP"
+_SERVE_PUB_KILL_PUBLISH = "BFTPU_CHAOS_SERVE_PUB_KILL_PUBLISH"
+_SERVE_PUB_KILL_PHASE = "BFTPU_CHAOS_SERVE_PUB_KILL_PHASE"
 
 _ALL_KEYS = (_KILL_RANK, _KILL_STEP, _DELAY_S,
              _JOIN_RANK, _JOIN_STEP,
              _SUSPEND_RANK, _SUSPEND_STEP, _SUSPEND_S,
              _SLOW_RANK, _SLOW_STEP, _SLOW_S, _SLOW_STOP,
-             _PARTITION_GROUP, _PARTITION_STEP, _PARTITION_STOP)
+             _PARTITION_GROUP, _PARTITION_STEP, _PARTITION_STOP,
+             _SERVE_KILL_REPLICA, _SERVE_KILL_SWAP, _SERVE_KILL_STOP,
+             _SERVE_PUB_KILL_PUBLISH, _SERVE_PUB_KILL_PHASE)
 
 # sim-campaign knobs (bluefog_tpu/sim/__main__.py reads these as CLI
 # defaults) — scrubbed by clear_schedule() alongside the chaos keys,
@@ -95,6 +104,13 @@ _SIM_KEYS = ("BFTPU_SIM_SEED", "BFTPU_SIM_RANKS", "BFTPU_SIM_ROUNDS",
 _LAB_KEYS = ("BFTPU_LAB_PROBE", "BFTPU_LAB_AUTO_TOPOLOGY",
              "BFTPU_LAB_PAYLOAD_BYTES", "BFTPU_LAB_ARTIFACT",
              "BFTPU_LAB_SAMPLE", "BFTPU_LAB_FLUSH")
+
+# serving-plane knobs (bluefog_tpu.serve): a stale lag bound or stale
+# policy leaking across tests flips the next replica fleet from warn to
+# refuse (or vice versa) — schedule-grade state, same as the lab keys
+_SERVE_KEYS = ("BFTPU_SERVE_MAX_LAG", "BFTPU_SERVE_STALE_POLICY",
+               "BFTPU_SERVE_RETRIES", "BFTPU_SERVE_BACKOFF_S",
+               "BFTPU_SERVE_REPLICAS")
 
 # injectable clock (sim/clock.py seam) for the delay/straggler sleeps;
 # process-level signals (suspend_self) always use wall time — you
@@ -226,6 +242,39 @@ def schedule_partition(env: dict, group: str, step: int,
     return env
 
 
+def schedule_serve_kill(env: dict, replica: int, swap: int,
+                        stop: Optional[int] = None) -> dict:
+    """Publish a REPLICA MID-SWAP kill schedule: replica ``replica``
+    SIGKILLs itself at its ``swap``-th hot-swap, precisely between
+    reading the new committed snapshot and the atomic version flip
+    (``Replica.poll_swap``).  ``stop`` is the respawn round — like the
+    partition stop it is acted on by harnesses that own the fleet (the
+    simulator; an e2e respawning the replica), not by the replica
+    itself, and exists so the fault round-trips the shared schedule
+    format."""
+    env[_SERVE_KILL_REPLICA] = str(int(replica))
+    env[_SERVE_KILL_SWAP] = str(int(swap))
+    if stop is not None:
+        env[_SERVE_KILL_STOP] = str(int(stop))
+    return env
+
+
+def schedule_serve_pub_kill(env: dict, publish: int,
+                            phase: str = "payload") -> dict:
+    """Publish a PUBLISHER MID-PUBLISH kill schedule: the publisher
+    SIGKILLs itself during its ``publish``-th snapshot publication —
+    ``phase="payload"`` dies with the standby buffer half-written (seq
+    odd), ``phase="flip"`` dies with the payload whole but the header
+    not yet flipped.  Both must leave every replica on the previous
+    committed version (``SnapshotRegion``'s death matrix)."""
+    if phase not in ("payload", "flip"):
+        raise ValueError(f"serve_pub_kill phase {phase!r} "
+                         "(want 'payload' or 'flip')")
+    env[_SERVE_PUB_KILL_PUBLISH] = str(int(publish))
+    env[_SERVE_PUB_KILL_PHASE] = phase
+    return env
+
+
 def schedule_to_json() -> str:
     """Serialize the calling process's env-published chaos schedule to
     the shared fault-schedule JSON (see
@@ -250,9 +299,9 @@ def apply_schedule_json(payload: str, env: Optional[dict] = None) -> dict:
 def clear_schedule() -> None:
     """Scrub EVERY chaos key from the calling process's environment —
     kill, join, and suspend schedules alike (a stale key would replay
-    the fault in the next test's workers) — plus the sim-campaign and
-    lab keys, which are schedules by another name."""
-    for k in _ALL_KEYS + _SIM_KEYS + _LAB_KEYS:
+    the fault in the next test's workers) — plus the sim-campaign,
+    lab, and serving-plane keys, which are schedules by another name."""
+    for k in _ALL_KEYS + _SIM_KEYS + _LAB_KEYS + _SERVE_KEYS:
         os.environ.pop(k, None)
 
 

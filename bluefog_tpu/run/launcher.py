@@ -26,6 +26,10 @@ Usage:
   bftpu-run --islands 4 --self-heal python async_train.py
                                                # elastic fleet: signal-killed
                                                # ranks respawn as joiners
+  bftpu-run --islands 4 --serve-replicas 2 python async_train.py
+                                               # + 2 inference replicas
+                                               # hot-swapping published
+                                               # weight snapshots
   bftpu-run --attach JOB scale +2              # resize a running islands job
 """
 
@@ -663,6 +667,18 @@ def main(argv=None) -> int:
         "column (docs/OBSERVABILITY.md, 'Convergence observatory')",
     )
     parser.add_argument(
+        "--serve-replicas",
+        type=int,
+        default=0,
+        metavar="K",
+        help="islands mode: spawn K inference replica processes "
+        "(python -m bluefog_tpu.serve) subscribed to the job's snapshot "
+        "region — each hot-swaps to every version the training fleet "
+        "publishes via islands.serve_publish, with zero serving "
+        "downtime; replicas are torn down when the fleet exits "
+        "(docs/SERVING.md)",
+    )
+    parser.add_argument(
         "--attach",
         default=None,
         metavar="JOB",
@@ -699,10 +715,14 @@ def main(argv=None) -> int:
         elif args.np != total:
             parser.error(f"-np {args.np} but -H lists {total} slots")
 
+    if args.serve_replicas and not args.islands:
+        parser.error("--serve-replicas requires --islands (the snapshot "
+                     "region is published by an islands fleet)")
     env = build_env(args)
     if args.islands:
         return _run_islands(cmd, env, args.islands, args.job, hosts,
-                            args.timeout, self_heal=args.self_heal)
+                            args.timeout, self_heal=args.self_heal,
+                            serve_replicas=args.serve_replicas)
     if args.np is not None and args.np > 1 and args.process_id is None:
         # `-np N` with no explicit process id: WE are the process launcher
         # (the reference's `bfrun -np N` execs mpirun which forks the ranks
@@ -837,7 +857,7 @@ def _collect_traces(env: dict, job: str) -> None:
 
 
 def _run_islands(cmd, env, nranks: int, job, hosts, timeout: float,
-                 self_heal: bool = False) -> int:
+                 self_heal: bool = False, serve_replicas: int = 0) -> int:
     """Fork N island processes (the `mpirun -np N` shape of the reference's
     launcher [U]).  With ``-H``, ranks spawn on their hosts over ssh and
     the hostmap/coordinator env is set so window traffic rides shared
@@ -890,6 +910,17 @@ def _run_islands(cmd, env, nranks: int, job, hosts, timeout: float,
                     socket.getfqdn() if _is_local_host(by_rank[r])
                     else by_rank[r])
             ranks.append(_spawn_rank(by_rank[r], cmd, child_env, tag, r))
+        # serving fleet: local replica processes subscribed to the
+        # job's snapshot region.  They poll until the first publish
+        # lands, hot-swap each version, and are torn down with the
+        # fleet — a replica exiting never fails the training run.
+        serve_procs = []
+        for i in range(serve_replicas):
+            rc = dict(env)
+            rc["BFTPU_SERVE_REPLICAS"] = str(serve_replicas)
+            serve_procs.append(subprocess.Popen(
+                [sys.executable, "-m", "bluefog_tpu.serve",
+                 "--job", job, "--replica-id", str(i)], env=rc))
         control = None
         try:
             if multi_host:
@@ -907,6 +938,14 @@ def _run_islands(cmd, env, nranks: int, job, hosts, timeout: float,
         finally:
             if control is not None:
                 control.stop()
+            for p in serve_procs:
+                if p.poll() is None:
+                    p.terminate()
+            for p in serve_procs:
+                try:
+                    p.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    p.kill()
             _cleanup_island_segments(job, by_rank)
             _collect_telemetry(env, job)
             _collect_traces(env, job)

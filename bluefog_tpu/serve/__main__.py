@@ -1,0 +1,59 @@
+"""One inference replica process: ``python -m bluefog_tpu.serve``.
+
+``bftpu-run --serve-replicas K`` spawns K of these next to the training
+island.  The loop is deliberately boring — poll, maybe swap, serve —
+because every interesting behavior (retry, staleness, chaos) lives in
+:class:`bluefog_tpu.serve.replica.Replica` where tests can reach it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from bluefog_tpu.serve.replica import Replica, StaleSnapshotError
+from bluefog_tpu.serve.snapshot import SnapshotUnavailable
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m bluefog_tpu.serve",
+        description="Run one inference replica against a job's "
+                    "snapshot region.")
+    ap.add_argument("--job", required=True, help="job name to subscribe to")
+    ap.add_argument("--replica-id", type=int, default=0)
+    ap.add_argument("--poll-s", type=float, default=0.02,
+                    help="seconds between region polls")
+    ap.add_argument("--steps", type=int, default=0,
+                    help="exit after N serve steps (0 = run until killed)")
+    ap.add_argument("--duration-s", type=float, default=0.0,
+                    help="exit after this many seconds (0 = no limit)")
+    args = ap.parse_args(argv)
+
+    rep = Replica(args.job, args.replica_id)
+    t_end = time.monotonic() + args.duration_s if args.duration_s else None
+    try:
+        while True:
+            try:
+                rep.poll_swap()
+                rep.serve_step()
+            except SnapshotUnavailable:
+                pass  # nothing committed yet — keep polling
+            except StaleSnapshotError as e:
+                print(f"[serve r{args.replica_id}] refusing: {e}",
+                      file=sys.stderr)
+            if args.steps and rep.serve_steps >= args.steps:
+                break
+            if t_end is not None and time.monotonic() >= t_end:
+                break
+            time.sleep(args.poll_s)
+    finally:
+        print(f"[serve r{args.replica_id}] version={rep.version} "
+              f"swaps={rep.swaps} steps={rep.serve_steps} lag={rep.lag}")
+        rep.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

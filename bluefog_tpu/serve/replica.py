@@ -1,0 +1,306 @@
+"""The inference replica: subscribe, hot-swap, never serve torn bytes.
+
+A replica keeps exactly one in-memory snapshot (the A buffer in its own
+address space) and polls the job's snapshot region; a newer committed
+version is read to the side (B fills while A serves) and installed by a
+single reference flip, so there is no serve-path downtime and no
+intermediate state — a SIGKILL between the read and the flip (the chaos
+hook drives exactly that) just means the next incarnation re-reads the
+same committed version.
+
+The served version is **strictly monotone per replica**: a region
+re-read can only move the replica forward, and a publisher handoff
+cannot regress it because the committed word itself is monotone
+(:mod:`bluefog_tpu.serve.snapshot`).
+
+Degradation contract (docs/SERVING.md):
+
+- transient trouble (region missing, torn bracket, `PeerTimeoutError`,
+  `OrphanedError` from a quiesced publisher) → bounded full-jitter
+  retry, then keep serving the current snapshot;
+- lag beyond ``BFTPU_SERVE_MAX_LAG`` → policy-selectable via
+  ``BFTPU_SERVE_STALE_POLICY``: ``warn`` serves stale and journals,
+  ``refuse`` raises :class:`StaleSnapshotError` so the caller can shed
+  load instead of serving ancient weights.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from bluefog_tpu import telemetry as _telemetry
+from bluefog_tpu.serve import snapshot as _snap
+from bluefog_tpu.serve.snapshot import (SnapshotUnavailable,
+                                        TornSnapshotError)
+
+__all__ = [
+    "Replica",
+    "ShmSource",
+    "StaleSnapshotError",
+    "full_jitter",
+    "serve_max_lag",
+    "serve_stale_policy",
+    "REPLICA_RANK_BASE",
+]
+
+#: replicas publish status pages as ranks >= this offset, so one
+#: ``bftpu-top`` attach shows the training island and the serving fleet
+#: side by side without rank collisions (islands are bounded well below)
+REPLICA_RANK_BASE = 1000
+
+
+def serve_max_lag() -> int:
+    """``BFTPU_SERVE_MAX_LAG``: how many committed versions a replica
+    may trail before the stale policy kicks in (0 = unbounded)."""
+    try:
+        return max(0, int(os.environ.get("BFTPU_SERVE_MAX_LAG", "0")))
+    except ValueError:
+        return 0
+
+
+def serve_stale_policy() -> str:
+    """``BFTPU_SERVE_STALE_POLICY``: ``warn`` (serve stale, journal) or
+    ``refuse`` (raise so the caller sheds load)."""
+    v = os.environ.get("BFTPU_SERVE_STALE_POLICY", "warn")
+    return v if v in ("warn", "refuse") else "warn"
+
+
+def serve_retries() -> int:
+    try:
+        return max(1, int(os.environ.get("BFTPU_SERVE_RETRIES", "5")))
+    except ValueError:
+        return 5
+
+
+def serve_backoff_s() -> float:
+    try:
+        return float(os.environ.get("BFTPU_SERVE_BACKOFF_S", "0.05"))
+    except ValueError:
+        return 0.05
+
+
+def full_jitter(attempt: int, base: float, cap: float = 2.0,
+                rng: Optional[random.Random] = None) -> float:
+    """Full-jitter backoff: ``uniform(0, min(cap, base * 2**attempt))``.
+
+    The deterministic ``base * 2**attempt`` schedule resynchronizes a
+    fleet (every replica that lost the publisher at the same instant
+    retries at the same instant — a thundering herd); sampling the whole
+    interval decorrelates them.  Same shape as the TCP reconnect
+    backoff (``tcp_transport._backoff``)."""
+    bound = min(float(cap), float(base) * (2 ** max(0, int(attempt))))
+    r = rng if rng is not None else random
+    return r.uniform(0.0, bound) if bound > 0 else 0.0
+
+
+class StaleSnapshotError(RuntimeError):
+    """Served lag exceeded ``BFTPU_SERVE_MAX_LAG`` under the ``refuse``
+    policy."""
+
+    def __init__(self, msg: str, lag: int = -1, max_lag: int = -1):
+        super().__init__(msg)
+        self.lag = int(lag)
+        self.max_lag = int(max_lag)
+
+
+def _kill_replica() -> int:
+    """Chaos: replica id whose Nth swap is killed mid-flight (-1 off)."""
+    try:
+        return int(os.environ.get("BFTPU_CHAOS_SERVE_KILL_REPLICA", "-1"))
+    except ValueError:
+        return -1
+
+
+def _kill_swap() -> int:
+    """Chaos: the swap ordinal at which the kill fires (default 1)."""
+    try:
+        return int(os.environ.get("BFTPU_CHAOS_SERVE_KILL_SWAP", "1"))
+    except ValueError:
+        return 1
+
+
+class ShmSource:
+    """The single-host source: the job's seqlock'd snapshot region."""
+
+    def __init__(self, job: str):
+        self.job = str(job)
+
+    def poll(self) -> Tuple[int, int, int, np.ndarray]:
+        return _snap.read_committed(self.job)
+
+
+#: exception classes the bounded-backoff retry treats as transient; the
+#: TCP source's PeerTimeoutError and a quiesced publisher's
+#: OrphanedError are appended lazily (keeps this module importable
+#: without the native transport stack)
+def _transient_errors() -> tuple:
+    errs = [SnapshotUnavailable, TornSnapshotError, OSError]
+    try:
+        from bluefog_tpu.native.tcp_transport import PeerTimeoutError
+        errs.append(PeerTimeoutError)
+    except Exception:
+        pass
+    try:
+        from bluefog_tpu.resilience.quorum import OrphanedError
+        errs.append(OrphanedError)
+    except Exception:
+        pass
+    return tuple(errs)
+
+
+class Replica:
+    """One serving process: poll → side-read → atomic flip → serve."""
+
+    def __init__(self, job: str, replica_id: int = 0, *,
+                 source=None, rng: Optional[random.Random] = None,
+                 publish_page: bool = True):
+        self.job = str(job)
+        self.replica_id = int(replica_id)
+        self.source = source if source is not None else ShmSource(job)
+        self._rng = rng if rng is not None else random.Random()
+        # the A buffer: (version, epoch, step, tensor) flipped as one ref
+        self._current: Optional[Tuple[int, int, int, np.ndarray]] = None
+        #: newest committed version observed at the region, even when
+        #: the swap was skipped — the lag denominator
+        self.published_version = 0
+        self.swaps = 0
+        self.serve_steps = 0
+        self.stale_served = 0
+        self.retries = 0
+        self._page = None
+        if publish_page:
+            from bluefog_tpu.introspect.statuspage import StatusPage
+            self._page = StatusPage(job, REPLICA_RANK_BASE + self.replica_id)
+            self._publish_page("attach")
+
+    # -- observability -----------------------------------------------------
+
+    @property
+    def version(self) -> int:
+        """The version this replica is serving (0 = nothing yet)."""
+        return self._current[0] if self._current is not None else 0
+
+    @property
+    def lag(self) -> int:
+        return max(0, self.published_version - self.version)
+
+    def _publish_page(self, op: str) -> None:
+        if self._page is None:
+            return
+        cur = self._current
+        self._page.publish(
+            nranks=0, step=self.serve_steps,
+            epoch=cur[1] if cur else 0, op_id=self.swaps,
+            last_op=op, serve_version=self.version, serve_lag=self.lag)
+
+    # -- subscribe / swap --------------------------------------------------
+
+    def _poll_with_retry(self) -> Tuple[int, int, int, np.ndarray]:
+        reg = _telemetry.get_registry()
+        errs = _transient_errors()
+        base, cap = serve_backoff_s(), 2.0
+        last: Optional[Exception] = None
+        for attempt in range(serve_retries()):
+            try:
+                return self.source.poll()
+            except errs as e:
+                last = e
+                self.retries += 1
+                delay = full_jitter(attempt, base, cap, self._rng)
+                if reg.enabled:
+                    reg.counter("serve.retries",
+                                replica=str(self.replica_id)).inc()
+                    reg.journal("serve_retry", replica=self.replica_id,
+                                attempt=attempt + 1, backoff_s=delay,
+                                error=type(e).__name__)
+                time.sleep(delay)
+        assert last is not None
+        raise last
+
+    def poll_swap(self) -> bool:
+        """One subscribe cycle.  Reads the committed snapshot (bounded
+        jittered retries on transient errors), and hot-swaps iff it is
+        strictly newer than what we serve.  Returns True on a swap.
+
+        Raises the last transient error only when we have NOTHING to
+        serve yet; once a snapshot is installed, poll trouble degrades
+        to serving the current version (the zero-downtime contract)."""
+        reg = _telemetry.get_registry()
+        t0 = time.monotonic()
+        try:
+            version, epoch, step, arr = self._poll_with_retry()
+        except _transient_errors():
+            if self._current is None:
+                raise
+            return False
+        self.published_version = max(self.published_version, version)
+        if self._current is not None and version <= self._current[0]:
+            return False  # monotone: never regress, never re-swap
+        # B is filled (arr lives only in this frame); chaos kills the
+        # replica exactly here — mid-swap, after the read, before the
+        # flip — and the e2e asserts A kept serving until the kill
+        if (self.replica_id == _kill_replica()
+                and self.swaps + 1 == _kill_swap()):
+            from bluefog_tpu.resilience import chaos as _chaos
+            _chaos.kill_self()
+        self._current = (version, epoch, step, arr)  # the atomic flip
+        self.swaps += 1
+        if reg.enabled:
+            reg.counter("serve.swaps", replica=str(self.replica_id)).inc()
+            reg.gauge("serve.version",
+                      replica=str(self.replica_id)).set(version)
+            reg.gauge("serve.lag", replica=str(self.replica_id)).set(self.lag)
+            reg.histogram("serve.swap_s").observe(time.monotonic() - t0)
+            reg.journal("serve_swap", replica=self.replica_id,
+                        version=version, epoch=epoch, step=step,
+                        lag=self.lag)
+        self._publish_page("swap")
+        return True
+
+    # -- serve -------------------------------------------------------------
+
+    def serve_step(self, x: Optional[np.ndarray] = None):
+        """One inference step against the installed snapshot.
+
+        Returns ``(version, y)`` where ``y`` is ``snapshot @ x`` (or the
+        snapshot itself when ``x`` is None — zero-copy).  Never reads
+        the region: swap and serve are decoupled, which is what makes
+        mid-swap death a non-event for in-flight requests."""
+        cur = self._current
+        if cur is None:
+            raise SnapshotUnavailable(
+                f"replica {self.replica_id}: nothing committed yet")
+        version, _epoch, _step, arr = cur
+        lag, max_lag = self.lag, serve_max_lag()
+        reg = _telemetry.get_registry()
+        if max_lag and lag > max_lag:
+            if serve_stale_policy() == "refuse":
+                if reg.enabled:
+                    reg.counter("serve.refused",
+                                replica=str(self.replica_id)).inc()
+                raise StaleSnapshotError(
+                    f"replica {self.replica_id} is {lag} versions behind "
+                    f"(BFTPU_SERVE_MAX_LAG={max_lag}, policy=refuse)",
+                    lag=lag, max_lag=max_lag)
+            self.stale_served += 1
+            if reg.enabled:
+                reg.counter("serve.stale_served",
+                            replica=str(self.replica_id)).inc()
+                reg.journal("serve_stale", replica=self.replica_id,
+                            version=version, lag=lag, max_lag=max_lag)
+        self.serve_steps += 1
+        if reg.enabled:
+            reg.counter("serve.steps", replica=str(self.replica_id)).inc()
+        if x is None:
+            return version, arr
+        return version, arr.reshape(-1) @ np.asarray(x).reshape(-1)
+
+    def close(self, unlink: bool = False) -> None:
+        if self._page is not None:
+            self._page.close(unlink)
+            self._page = None

@@ -92,13 +92,26 @@ class SimConfig:
     # merges back on heal; "off" lets every side heal (pre-quorum
     # behavior, split-brain territory under partitions)
     quorum: str = "majority"
+    # serving plane: serve_every > 0 arms a publisher analog — the
+    # lowest live rank commits its debiased estimate as snapshot
+    # version v+1 every serve_every rounds (quorum-fenced exactly like
+    # islands.serve_publish) — and serve_replicas > 0 spawns hot-swap
+    # replica models that flip to the newest committed version and
+    # serve from it each round.  Both default OFF, and every serve
+    # event is gated on them, so a serve-disabled config logs zero new
+    # events: existing digests and repro files are unchanged.
+    serve_every: int = 0
+    serve_replicas: int = 0
     # plumbing
     max_events: int = 20_000_000
     journal_dir: Optional[str] = None
     # seeded bugs the campaign should CATCH: mass_leak (combine leaks
     # mass), cap_bypass (no minority demotion cap), split_brain (the
     # quorum fence is skipped, so both partition sides heal and the
-    # single-lineage invariant fires)
+    # single-lineage invariant fires), serve_version_reset (a publisher
+    # handoff restarts snapshot versions at 1 — the serve-monotone
+    # invariant fires), serve_torn (replica swaps mix old and new
+    # buffer bytes — the serve-committed invariant fires)
     debug_bugs: Tuple[str, ...] = ()
     # convergence observatory (bluefog_tpu.lab): record per-rank
     # successive-estimate differences each round.  The trace rides in

@@ -45,7 +45,15 @@ on the victim's own round counter, exactly like
   heal while the minority ORPHANs (parks its rounds, touches neither
   the board nor the shared ledgers), and on heal the orphans merge
   back through the real join machinery carrying their debiased
-  estimates with their stale mass written off.
+  estimates with their stale mass written off;
+- ``serve_kill`` — a serving replica (see ``cfg.serve_replicas``)
+  dies mid-hot-swap on its ``step``-th swap attempt and, when
+  ``stop`` is set, respawns at that round as a fresh incarnation;
+- ``serve_pub_kill`` — the publisher dies on its ``step``-th publish,
+  mid-payload (``group="payload"``: the standby buffer is torn,
+  nothing commits, survivors keep the previous version) or mid-flip
+  (``group="flip"``: the buffer is whole, the successor's attach
+  repairs forward to the new version).
 
 Invariants are checked after every protocol event (see
 :mod:`bluefog_tpu.sim.invariants`); violations are recorded, never
@@ -159,16 +167,37 @@ class SimFleet:
         self._board_group = 0
         self._lineage: Set[int] = set()
         self._partition_anchor: Optional[Tuple[float, float]] = None
+        # serving plane (armed only when cfg.serve_every > 0): the
+        # committed snapshot history (version, payload), the region
+        # header's persisted version word, the fleet-wide publish
+        # ordinal (serve_pub_kill faults index it), and the replica
+        # models keyed by replica id (rank 1000+i in logs, mirroring
+        # REPLICA_RANK_BASE)
+        self._serve_every = int(getattr(cfg, "serve_every", 0) or 0)
+        self._serve_replica_n = int(
+            getattr(cfg, "serve_replicas", 0) or 0)
+        self._serve_committed: List[Tuple[int, float]] = []
+        self._serve_version = 0
+        self._serve_pub_count = 0
+        self._serve_replicas: Dict[int, dict] = {}
         # faults indexed by (victim global rank, step); joins and
-        # partitions fire on their own timers (no single victim)
+        # partitions fire on their own timers (no single victim);
+        # serve faults key on replica id / publish ordinal instead of
+        # global rank, so they must not land in the rank-fault map
         self._faults: Dict[Tuple[int, int], Fault] = {}
         self._join_faults: List[Fault] = []
         self._partition_faults: List[Fault] = []
+        self._serve_kill_faults: Dict[int, Fault] = {}
+        self._serve_pub_faults: Dict[int, Fault] = {}
         for f in self.schedule:
             if f.kind == "join":
                 self._join_faults.append(f)
             elif f.kind == "partition":
                 self._partition_faults.append(f)
+            elif f.kind == "serve_kill":
+                self._serve_kill_faults[f.rank] = f
+            elif f.kind == "serve_pub_kill":
+                self._serve_pub_faults[f.step] = f
             else:
                 self._faults[(f.rank, f.step)] = f
         self._build()
@@ -265,6 +294,15 @@ class SimFleet:
             self.loop.at(_T0 + off * cfg.hb_interval, self._hb_event(g))
             self.loop.at(_T0 + off * cfg.round_period,
                          self._round_event(g))
+        if self._serve_every > 0:
+            for i in range(self._serve_replica_n):
+                self._serve_replicas[i] = {
+                    "version": 0, "payload": None, "swaps": 0,
+                    "steps": 0, "killed": False, "fired": False}
+                off = 0.0 if getattr(cfg, "lockstep", False) \
+                    else ((1000 + i) * 37 % 101) / 101.0
+                self.loop.at(_T0 + off * cfg.round_period,
+                             self._serve_replica_event(i))
         for f in self._join_faults:
             self.loop.at(_T0 + f.step * cfg.round_period,
                          self._joiner_event(f))
@@ -430,6 +468,14 @@ class SimFleet:
         live = r.live_members()
         if live and r.g == min(live):
             self._check("round", r.g)
+        # 8. serving plane: the lowest live rank is the publisher —
+        # every cfg.serve_every rounds it commits its debiased
+        # estimate as the next snapshot version (quorum-fenced like
+        # the real islands.serve_publish; an orphan never reaches this
+        # line because its rounds are parked)
+        if (self._serve_every > 0 and live and r.g == min(live)
+                and r.round_idx % self._serve_every == 0):
+            self._serve_publish(r)
 
     # -- membership machinery ---------------------------------------------
 
@@ -780,6 +826,139 @@ class SimFleet:
             self._check("merge", j.g)
         return fire
 
+    # -- serving plane ------------------------------------------------------
+
+    def _kill_rank(self, r: SimRank) -> None:
+        """SIGKILL semantics shared with the ``kill`` fault: mass is
+        seized to the lost bucket and the in-slots sever; survivors
+        detect via heartbeat timeout and heal."""
+        r.killed = True
+        self.transport.kill(r.g)
+        self.transport.lost_x += r.x
+        self.transport.lost_p += r.p
+        r.x = 0.0
+        r.p = 0.0
+
+    def _serve_publish(self, r: SimRank) -> None:
+        """The publisher analog of ``islands.serve_publish``: fence on
+        quorum, then commit (version, debiased estimate) — the version
+        word persists fleet-wide (the region header survives publisher
+        death), so a successor continues strictly monotone."""
+        if r.orphaned:
+            # the quorum denial can land mid-round (the detector
+            # verdict at step 1 orphans the rank, but this round body
+            # keeps running) — the real serve_publish raises
+            # OrphanedError here via its _orphan_guard
+            self._log("serve_fenced", r.g, orphaned=True)
+            return
+        if self._quorum_on:
+            total = len(r.epoch_members)
+            dead = r.known_dead & set(r.epoch_members)
+            live_n = total - len(dead)
+            if not _quorum.quorum_met(live_n, total):
+                self._log("serve_fenced", r.g, live=live_n, total=total)
+                return
+        self._serve_pub_count += 1
+        version = self._serve_version + 1
+        if ("serve_version_reset" in self.cfg.debug_bugs
+                and self._serve_pub_count > 1):
+            version = 1  # seeded bug: handoff forgets the header word
+        f = self._serve_pub_faults.get(self._serve_pub_count)
+        payload = r.estimate
+        if f is not None:
+            phase = f.group or "payload"
+            self._log("serve_pub_kill", r.g,
+                      publish=self._serve_pub_count, phase=phase)
+            if phase == "flip":
+                # payload buffer whole, death mid-header-flip: the
+                # successor's attach repairs forward to this version
+                self._serve_commit(r.g, version, payload, repaired=True)
+            # payload phase: standby buffer torn (odd seq), header
+            # intact — nothing commits, survivors keep the old version
+            self._kill_rank(r)
+            self._check("serve_pub_kill", r.g)
+            return
+        self._serve_commit(r.g, version, payload)
+
+    def _serve_commit(self, g: int, version: int, payload: float,
+                      repaired: bool = False) -> None:
+        err = _inv.check_serve_version_monotone(self._serve_version,
+                                                version)
+        if err:
+            self._violate("serve-monotone", f"at publish: {err}", g)
+        self._serve_version = max(self._serve_version, version)
+        self._serve_committed.append((version, payload))
+        aux = {"repaired": True} if repaired else {}
+        self._log("serve_publish", g, version=version, **aux)
+
+    def _serve_replica_event(self, i: int):
+        def fire():
+            rep = self._serve_replicas[i]
+            if rep["killed"] or self._all_done() \
+                    or self.loop.now >= self.end_time:
+                return
+            self._serve_replica_step(i, rep)
+            self.loop.after(self.cfg.round_period,
+                            self._serve_replica_event(i))
+        return fire
+
+    def _serve_replica_join_event(self, i: int):
+        def fire():
+            rep = self._serve_replicas[i]
+            if self._all_done() or self.loop.now >= self.end_time:
+                return
+            # a respawned replica is a fresh incarnation: nothing
+            # installed, version floor back at 0 (per-replica
+            # monotonicity is per incarnation, as in the real fleet)
+            rep.update(version=0, payload=None, killed=False)
+            self._log("serve_replica_join", 1000 + i)
+            self.loop.after(0.0, self._serve_replica_event(i))
+        return fire
+
+    def _serve_replica_step(self, i: int, rep: dict) -> None:
+        if self._serve_committed:
+            version, payload = self._serve_committed[-1]
+            if version != rep["version"]:
+                f = self._serve_kill_faults.get(i)
+                if (f is not None and not rep["fired"]
+                        and rep["swaps"] + 1 == f.step):
+                    # die mid-swap (between the read and the flip):
+                    # nothing torn lands — the installed snapshot is
+                    # still whole when the process dies
+                    rep["fired"] = True
+                    rep["killed"] = True
+                    self._log("serve_replica_kill", 1000 + i,
+                              swap=rep["swaps"] + 1, version=version)
+                    if f.stop is not None:
+                        self.loop.at(
+                            _T0 + f.stop * self.cfg.round_period,
+                            self._serve_replica_join_event(i))
+                    return
+                err = _inv.check_serve_version_monotone(rep["version"],
+                                                        version)
+                if err:
+                    self._violate("serve-monotone",
+                                  f"replica {i}: {err}", 1000 + i)
+                new_payload = payload
+                if ("serve_torn" in self.cfg.debug_bugs
+                        and rep["payload"] is not None):
+                    # seeded bug: the swap mixes old and new buffer
+                    # bytes instead of flipping one whole generation
+                    new_payload = 0.5 * (rep["payload"] + payload)
+                rep["version"] = version
+                rep["payload"] = new_payload
+                rep["swaps"] += 1
+                self._log("serve_swap", 1000 + i, version=version)
+        # serve from whatever is installed; every served byte must be
+        # some committed snapshot (the torn-read invariant)
+        if rep["payload"] is not None:
+            err = _inv.check_serve_snapshot_committed(
+                rep["payload"], self._serve_committed)
+            if err:
+                self._violate("serve-committed",
+                              f"replica {i}: {err}", 1000 + i)
+            rep["steps"] += 1
+
     # -- adaptive demote/promote ------------------------------------------
 
     def _adaptive_step(self, r: SimRank) -> None:
@@ -1034,8 +1213,24 @@ class SimFleet:
         if self.cfg.journal_dir:
             self._write_snapshots(members)
         epoch = max((self.ranks[g].epoch for g in members), default=0)
-        return {"members": sorted(members), "epoch": epoch,
-                "ledger": ledger, "estimates": ests}
+        out = {"members": sorted(members), "epoch": epoch,
+               "ledger": ledger, "estimates": ests}
+        if self._serve_every > 0:
+            # replicas outlive the training rounds: one final poll so
+            # a replica whose cadence straddled the last publish still
+            # converges to the committed head before the audit
+            for i, rep in sorted(self._serve_replicas.items()):
+                if not rep["killed"]:
+                    self._serve_replica_step(i, rep)
+            out["serve"] = {
+                "published": self._serve_version,
+                "commits": len(self._serve_committed),
+                "replicas": {
+                    i: {"version": rep["version"],
+                        "swaps": rep["swaps"], "steps": rep["steps"],
+                        "killed": rep["killed"]}
+                    for i, rep in sorted(self._serve_replicas.items())}}
+        return out
 
     def _members_now(self) -> Set[int]:
         alive = [r for _, r in sorted(self.ranks.items())

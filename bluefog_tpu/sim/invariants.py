@@ -31,6 +31,8 @@ __all__ = [
     "check_consensus",
     "check_single_lineage",
     "check_partition_merge_mass",
+    "check_serve_version_monotone",
+    "check_serve_snapshot_committed",
     "demotion_cap",
 ]
 
@@ -162,6 +164,35 @@ def check_partition_merge_mass(anchor: Tuple[float, float],
                 f"{anchor[0]:.6g}, p residual {current[1] - anchor[1]:.3e}"
                 f" vs {anchor[1]:.6g}")
     return None
+
+
+def check_serve_version_monotone(prev: int, cur: int) -> Optional[str]:
+    """The serving plane's snapshot version is strictly monotone — at
+    the publisher (the region header survives publisher death, so a
+    successor must continue past the highest committed version, never
+    restart at 1) and at every replica (a hot-swap only ever installs
+    a NEWER version; flipping backward would serve stale weights to
+    traffic that already saw the new ones)."""
+    if cur <= prev:
+        return (f"serve version went backward: {prev} -> {cur} — a "
+                "publisher re-committed (or a replica flipped to) a "
+                "stale snapshot version")
+    return None
+
+
+def check_serve_snapshot_committed(served: float,
+                                   committed) -> Optional[str]:
+    """Whatever a replica serves must be byte-identical to SOME
+    committed snapshot — never a torn mix of two versions.  The
+    double-buffer seqlock guarantees this in the real region (a reader
+    that catches a mid-write buffer retries); ``committed`` is the
+    campaign's list of ``(version, payload)`` commits."""
+    if any(served == p for _, p in committed):
+        return None
+    vs = [v for v, _ in committed]
+    return (f"served payload {served!r} matches NO committed snapshot "
+            f"(committed versions {vs[:8]}{'...' if len(vs) > 8 else ''})"
+            " — a torn read mixed two buffer generations")
 
 
 def check_consensus(estimates: Dict[int, float], tol: float = 1e-6,

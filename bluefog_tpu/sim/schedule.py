@@ -28,6 +28,19 @@ partition network partition from ``step`` until ``stop``: cross-group
        stale across the cut (``BFTPU_CHAOS_PARTITION_GROUP``,
        ``BFTPU_CHAOS_PARTITION_STEP``, ``BFTPU_CHAOS_PARTITION_STOP``)
        / the quorum-fenced minority ORPHANs and merges back on heal
+serve_kill replica ``rank`` SIGKILLs mid-swap at its ``step``-th
+       hot-swap, respawning at round ``stop``
+       (``BFTPU_CHAOS_SERVE_KILL_REPLICA``,
+       ``BFTPU_CHAOS_SERVE_KILL_SWAP``,
+       ``BFTPU_CHAOS_SERVE_KILL_STOP``) / the sim replica dies between
+       read and flip, its served version stays monotone across rejoin
+serve_pub_kill the publisher SIGKILLs during its ``step``-th publish;
+       ``group`` carries the phase — ``payload`` (standby buffer torn)
+       or ``flip`` (payload whole, header not flipped)
+       (``BFTPU_CHAOS_SERVE_PUB_KILL_PUBLISH``,
+       ``BFTPU_CHAOS_SERVE_PUB_KILL_PHASE``) / survivors keep serving
+       the previous committed snapshot, the successor continues the
+       version sequence
 ====== ==========================================================
 
 A partition's sides ride in ``group``: a pipe-separated list of
@@ -58,10 +71,18 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from bluefog_tpu.resilience import chaos as _chaos
 
-__all__ = ["Fault", "FaultSchedule", "SCHEDULE_SCHEMA", "FAULT_KINDS"]
+__all__ = ["Fault", "FaultSchedule", "SCHEDULE_SCHEMA", "FAULT_KINDS",
+           "GENERATE_KINDS"]
 
 SCHEDULE_SCHEMA = "bftpu-fault-schedule/1"
-FAULT_KINDS = ("kill", "suspend", "slow", "join", "partition")
+FAULT_KINDS = ("kill", "suspend", "slow", "join", "partition",
+               "serve_kill", "serve_pub_kill")
+#: the kinds :meth:`FaultSchedule.generate` draws from by default — the
+#: classic fleet faults.  The serve kinds are opt-in (pass them in
+#: ``kinds`` explicitly): keeping the default draw set frozen keeps
+#: every previously pinned ``generate(seed, ...)`` schedule, and hence
+#: every pinned campaign event digest, bit-identical.
+GENERATE_KINDS = ("kill", "suspend", "slow", "join", "partition")
 
 
 @dataclasses.dataclass(frozen=True, order=True)
@@ -86,6 +107,11 @@ class Fault:
         if self.kind == "partition" and not self.group:
             raise ValueError("partition fault needs a group spec "
                              "(e.g. '3' or '0,1|6,7')")
+        if self.kind == "serve_pub_kill" and self.group not in (
+                "", "payload", "flip"):
+            raise ValueError(
+                f"serve_pub_kill phase {self.group!r} (the group field "
+                "carries the phase: 'payload' or 'flip')")
 
     def to_dict(self) -> dict:
         d = {"kind": self.kind, "step": int(self.step),
@@ -203,6 +229,12 @@ class FaultSchedule:
             elif kind == "partition":
                 _chaos.schedule_partition(env, f.group, f.step,
                                           stop=f.stop)
+            elif kind == "serve_kill":
+                _chaos.schedule_serve_kill(env, f.rank, f.step,
+                                           stop=f.stop)
+            elif kind == "serve_pub_kill":
+                _chaos.schedule_serve_pub_kill(
+                    env, f.step, phase=f.group or "payload")
         return env
 
     @classmethod
@@ -238,13 +270,26 @@ class FaultSchedule:
                 step=int(env.get(_chaos._PARTITION_STEP, "1")),
                 stop=None if stop is None else int(stop),
                 group=str(env[_chaos._PARTITION_GROUP])))
+        if _chaos._SERVE_KILL_REPLICA in env:
+            stop = env.get(_chaos._SERVE_KILL_STOP)
+            faults.append(Fault(
+                kind="serve_kill",
+                rank=int(env[_chaos._SERVE_KILL_REPLICA]),
+                step=int(env.get(_chaos._SERVE_KILL_SWAP, "1")),
+                stop=None if stop is None else int(stop)))
+        if _chaos._SERVE_PUB_KILL_PUBLISH in env:
+            faults.append(Fault(
+                kind="serve_pub_kill", rank=-1,
+                step=int(env[_chaos._SERVE_PUB_KILL_PUBLISH]),
+                group=str(env.get(_chaos._SERVE_PUB_KILL_PHASE,
+                                  "payload"))))
         return cls(faults)
 
     # -- seeded generation -------------------------------------------------
 
     @classmethod
     def generate(cls, seed: int, ranks: int, rounds: int,
-                 kinds: Sequence[str] = FAULT_KINDS,
+                 kinds: Sequence[str] = GENERATE_KINDS,
                  n_faults: Optional[int] = None,
                  max_kills_frac: float = 0.25) -> "FaultSchedule":
         """Deterministically derive a campaign schedule from a seed.
@@ -256,7 +301,8 @@ class FaultSchedule:
         ``random.Random`` — the same seed replays the same schedule.
         """
         rng = random.Random(int(seed))
-        kinds = tuple(k for k in kinds if k in FAULT_KINDS) or FAULT_KINDS
+        kinds = (tuple(k for k in kinds if k in FAULT_KINDS)
+                 or GENERATE_KINDS)
         if n_faults is None:
             n_faults = max(1, min(8, ranks // 8, rounds // 4))
         max_kills = max(1, int(ranks * max_kills_frac))
@@ -270,6 +316,18 @@ class FaultSchedule:
             if kind == "kill" and kills >= max_kills:
                 kind = "slow" if "slow" in kinds else "join"
             step = rng.randrange(1, horizon + 1)
+            if kind == "serve_kill":
+                # rank names the replica ordinal, not a fleet victim
+                faults.append(Fault(
+                    kind="serve_kill", step=max(1, step // 4),
+                    rank=rng.randrange(0, 2),
+                    stop=min(rounds, step + rng.randrange(3, 8))))
+                continue
+            if kind == "serve_pub_kill":
+                faults.append(Fault(
+                    kind="serve_pub_kill", step=max(1, step // 4),
+                    rank=-1, group=rng.choice(("payload", "flip"))))
+                continue
             if kind == "partition":
                 # one window at a time (the fleet runs one cut), the
                 # named side strictly sub-majority so the implicit rest
