@@ -1,0 +1,35 @@
+# Developer entry points for the static verifier and the test suite.
+#
+#   make verify          analysis self-test + fast rule corpus + tier-1 tests
+#   make analyze         fast rule corpus only (skips the compile-heavy hlo
+#                        family) — the pre-push gate, ~1 min
+#   make selftest        every seeded fixture / campaign / conformance /
+#                        interleave arm must fire or run clean
+#   make changed FILES="a.py b.py"
+#                        run only the rule families gating the listed files
+#                        (see conformance.FAMILY_MAP) — the pre-commit gate
+#   make test            tier-1 pytest (not slow)
+#
+# All targets force the CPU backend so they run on any host.
+
+PY      ?= python
+ENV     := JAX_PLATFORMS=cpu
+PYTEST  := $(ENV) $(PY) -m pytest tests/ -q -m 'not slow' \
+           --continue-on-collection-errors -p no:cacheprovider
+
+.PHONY: verify analyze selftest changed test
+
+verify: selftest analyze test
+
+analyze:
+	$(ENV) $(PY) -m bluefog_tpu.analysis --no-hlo
+
+selftest:
+	$(ENV) $(PY) -m bluefog_tpu.analysis --self-test
+
+changed:
+	@test -n "$(FILES)" || { echo "usage: make changed FILES=\"a.py b.py\""; exit 2; }
+	$(ENV) $(PY) -m bluefog_tpu.analysis --changed-only $(FILES) --no-hlo
+
+test:
+	$(PYTEST)

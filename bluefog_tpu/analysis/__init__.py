@@ -70,6 +70,26 @@ consumers (CLI, pytest, CI):
   series, scaling laws non-increasing in fleet size, measured rates
   rank-correlated with spectral gaps, every cell sim-oracle clean,
   and the stored recommendation map consistent with recomputation;
+- **transport** (:mod:`.transport_spec`) — the machine-readable window/
+  mailbox contract: an executable spec table pinning every protocol
+  constant (seqlock brackets, ascending chunk commit, drain-marker
+  semantics, dead-writer drain order, mass-ledger identity, epoch
+  quiesce/re-seed, holder-board stamps), a sequential
+  ``ReferenceTransport`` implementing the contract, and the capability
+  lint — every transport declares a :class:`TransportCaps` record, the
+  declarations are honest against the implementations, and every call
+  site relies only on declared capabilities;
+- **conformance** (:mod:`.conformance`) — the generative differential
+  harness: native shm, fallback shm, chunked TCP, legacy TCP and
+  ``SimTransport`` all driven through the same pinned-seed op schedules
+  as the reference model, observable state compared after every op,
+  divergences ddmin-shrunk to 1-minimal repro schedules;
+- **interleave** (:mod:`.interleave`) — the unified interleaving
+  explorer: protocol state machines written in one little language,
+  exhaustively explored with a vector-clock happens-before race check;
+  re-expresses (and cross-checks against) the seqlock, chunk-ring and
+  drain models and extends to the progress-engine queue and the serve
+  double-buffer;
 - the **fixture corpus** (:mod:`.fixtures`) — seeded bugs proving every
   rule fires.
 
@@ -91,10 +111,12 @@ from bluefog_tpu.analysis.engine import (  # noqa: F401
 # importing the family modules populates ``registry``
 from bluefog_tpu.analysis import (  # noqa: F401
     adaptive_rules,
+    conformance,
     epoch_rules,
     fixtures,
     hlo_corpus,
     hlo_rules,
+    interleave,
     introspect_rules,
     lab_rules,
     partition_rules,
@@ -106,6 +128,7 @@ from bluefog_tpu.analysis import (  # noqa: F401
     sim_rules,
     telemetry_rules,
     trace_rules,
+    transport_spec,
     wire_rules,
 )
 

@@ -10,6 +10,11 @@ Modes:
   claims to catch;
 - ``--self-test``: run every fixture and fail unless each yields at
   least one finding;
+- ``--family NAME``: run exactly one rule family (``--list-families``
+  shows every family with its documented runtime);
+- ``--changed-only FILE [FILE ...]``: map touched source files to the
+  rule families that gate them (``conformance.FAMILY_MAP``) and run only
+  those — the pre-commit mode;
 - ``--list``: enumerate rules and fixtures;
 - ``--json``: machine-readable report.
 
@@ -41,6 +46,16 @@ def main(argv=None) -> int:
                     "shm-mailbox protocol")
     p.add_argument("--families", nargs="*", default=None,
                    help="rule families to run (default: all)")
+    p.add_argument("--family", default=None,
+                   help="run exactly one rule family (see --list-families)")
+    p.add_argument("--list-families", action="store_true",
+                   dest="list_families",
+                   help="list rule families with rule counts and the "
+                        "documented runtime of each")
+    p.add_argument("--changed-only", nargs="+", default=None,
+                   metavar="FILE",
+                   help="run only the families gating these touched "
+                        "source files (the pre-commit mode)")
     p.add_argument("--no-hlo", action="store_true",
                    help="skip the compile-heavy hlo family (fast CI gate)")
     p.add_argument("--fixture", default=None,
@@ -65,6 +80,35 @@ def main(argv=None) -> int:
         print()
         for name in fixtures.FIXTURES:
             print(f"fixture: {name}")
+        return 0
+
+    if args.list_families:
+        # rough wall-clock on the CI container, measured once and kept
+        # honest by the CLI timing test in tests/test_analysis.py
+        runtime = {
+            "plan": "~5 s (topology sweeps 2..64)",
+            "hlo": "~60-120 s (jit+lower the HLO corpus — the slow one)",
+            "protocol": "~2 s (exhaustive interleavings, small bounds)",
+            "resilience": "~5 s (healed-topology sweeps + drain model)",
+            "telemetry": "~1 s", "trace": "~1 s", "adaptive": "~5 s",
+            "epoch": "<1 s", "progress": "~2 s",
+            "wire": "~3 s (chunk-stream + credit-window models)",
+            "introspect": "~2 s", "sim": "~10 s (pinned fault campaigns)",
+            "partition": "~10 s (pinned partition campaigns)",
+            "serve": "~10 s (pinned serve campaigns + buffer model)",
+            "lab": "~5 s (frozen sweep artifact re-derivation)",
+            "transport": "<1 s (spec table pins + capability lint)",
+            "conformance": "~5 s (differential transports vs reference; "
+                           "includes two live TCP rank pairs)",
+            "interleave": "~2 s (unified explorer + race scan)",
+        }
+        rules_by_family = {}
+        for rule in analysis.registry.select():
+            rules_by_family.setdefault(rule.family, []).append(rule.name)
+        for fam in sorted(rules_by_family):
+            n = len(rules_by_family[fam])
+            print(f"{fam:<12s} {n:>2d} rule(s)  "
+                  f"{runtime.get(fam, '(unmeasured)')}")
         return 0
 
     if args.fixture is not None:
@@ -172,14 +216,49 @@ def main(argv=None) -> int:
             print("self-test FAILED: frozen lab artifact fails its own "
                   "checks")
             return 1
+        # conformance arm: the live differential corpus must run clean
+        # and every seeded transport mutant must be caught
+        from bluefog_tpu.analysis import conformance
+
+        broken = []
+        for label, ok, detail in conformance.selftest_conformance():
+            print(f"  {label:<36s} {'ok' if ok else 'FAILED'} ({detail})")
+            if not ok:
+                broken.append(label)
+        if broken:
+            print(f"self-test FAILED: conformance arm(s) failed {broken}")
+            return 1
+        # interleave arm: the unified explorer must agree with the
+        # legacy models and catch every seeded protocol bug
+        from bluefog_tpu.analysis import interleave
+
+        split = []
+        for label, ok, detail in interleave.selftest_interleave():
+            print(f"  {label:<40s} {'ok' if ok else 'FAILED'} ({detail})")
+            if not ok:
+                split.append(label)
+        if split:
+            print(f"self-test FAILED: interleave arm(s) failed {split}")
+            return 1
         print(f"self-test OK: all {len(fixtures.FIXTURES)} seeded bugs "
               f"caught, {len(sim_rules.SELFTEST_PINS)} pinned campaigns "
               f"+ {len(partition_rules.PARTITION_PINS)} partition "
               f"+ {len(serve_rules.SERVE_PINS)} serve campaigns clean, "
-              f"lab artifact verified ({ncells} cells)")
+              f"lab artifact verified ({ncells} cells), transports "
+              f"conformant, unified explorer subsumes the legacy models")
         return 0
 
     families = args.families
+    if args.family is not None:
+        if args.family not in analysis.registry.families():
+            p.error(f"unknown family {args.family!r}; see --list-families")
+        families = [args.family]
+    if args.changed_only is not None:
+        from bluefog_tpu.analysis.conformance import families_for_paths
+
+        families = families_for_paths(args.changed_only)
+        print(f"changed-only: {len(args.changed_only)} file(s) -> "
+              f"families {families}")
     if args.no_hlo:
         families = [f for f in (families or analysis.registry.families())
                     if f != "hlo"]

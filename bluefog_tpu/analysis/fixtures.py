@@ -668,6 +668,41 @@ def _lab_recommendation_contradicts_corpus() -> List[Finding]:
         art, "LAB[swapped-recommendation]")
 
 
+def _conformance_out_of_order_commit() -> List[Finding]:
+    """A transport that buffers deposits and commits them LIFO: the
+    ascending-commit contract breaks and the differential harness must
+    shrink the divergence to its minimal repro."""
+    from bluefog_tpu.analysis import conformance
+
+    return conformance.mutant_out_of_order_findings()
+
+
+def _conformance_capability_overclaim() -> List[Finding]:
+    """A transport whose CAPS record claims a fused scale (and a future
+    device-resident tier) its ``write`` cannot deliver: the capability
+    honesty lint must refuse the declaration."""
+    from bluefog_tpu.analysis import conformance
+
+    return conformance.mutant_overclaim_findings()
+
+
+def _conformance_drain_loses_mass() -> List[Finding]:
+    """A force-drain that wipes committed mass without crediting any
+    ledger bin: the reference mass identity must break."""
+    from bluefog_tpu.analysis import conformance
+
+    return conformance.mutant_lossy_drain_findings()
+
+
+def _conformance_epoch_reseed_skipped() -> List[Finding]:
+    """An epoch switch that retires the ledger but carries the old
+    epoch's slot state into the new one: the differential against the
+    reference re-seed must diverge on the first version observation."""
+    from bluefog_tpu.analysis import conformance
+
+    return conformance.mutant_reseed_findings()
+
+
 FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
     # plan family
     "plan-duplicate-destination": _plan_duplicate_destination,
@@ -768,6 +803,12 @@ FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
     "epoch-get-clobbers-put": lambda: epoch_rules.check_trace(
         [("win_create", "w"), ("win_put", "w"), ("win_get", "w"),
          ("win_update", "w")], subject="get-clobbers-put"),
+    # conformance family: transport mutants the differential harness,
+    # the mass ledger, and the capability lint must each catch
+    "conformance-out-of-order-commit": _conformance_out_of_order_commit,
+    "conformance-capability-overclaim": _conformance_capability_overclaim,
+    "conformance-drain-loses-mass": _conformance_drain_loses_mass,
+    "conformance-epoch-reseed-skipped": _conformance_epoch_reseed_skipped,
 }
 
 

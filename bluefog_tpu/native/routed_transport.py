@@ -38,6 +38,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from bluefog_tpu.native import capabilities as _caps
+
 
 def parse_hostmap(raw: str, nranks: int) -> List[str]:
     """``"0,0,1,1"`` or ``"0:a,1:a,2:b"`` → host label per rank."""
@@ -98,6 +100,11 @@ class RoutedWindow:
     of the two endpoints, passed as ``src``/``dst`` rank arguments.
     """
 
+    #: static floor: what routed may claim before knowing which shm leg
+    #: (native or fallback) an instance gets — the meet over every
+    #: possible leg pair.  __init__ upgrades to the actual legs' meet.
+    CAPS = None  # filled in below the class (needs the leg classes)
+
     def __init__(self, job: str, name: str, rank: int, nranks: int,
                  maxd: int, shape, dtype, hosts: List[str], coord: str):
         from bluefog_tpu.native.shm_native import make_shm_window
@@ -117,9 +124,15 @@ class RoutedWindow:
                 shape, dtype,
             )
             self._local_index = li
+            # caller-facing capabilities: a routed edge may take either
+            # leg, so only the meet of the two is honest
+            self.CAPS = _caps.meet(type(self.shm).CAPS, type(self.tcp).CAPS,
+                                   "routed")
         else:
             self.shm = None
             self._local_index = {}
+            self.CAPS = _caps.meet(type(self.tcp).CAPS,
+                                   type(self.tcp).CAPS, "routed")
 
     def _same_host(self, a: int, b: int) -> bool:
         return self.hosts[a] == self.hosts[b]
@@ -207,3 +220,18 @@ class RoutedWindow:
         # each host group's segment-rank-0 unlinks that host's segment
         if self.shm is not None:
             self.shm.unlink_segments()
+
+
+def _static_floor_caps() -> "_caps.TransportCaps":
+    from bluefog_tpu.native.shm_native import (FallbackShmWindow,
+                                               NativeShmWindow)
+    from bluefog_tpu.native.tcp_transport import TcpShmWindow
+
+    shm_floor = _caps.meet(NativeShmWindow.CAPS, FallbackShmWindow.CAPS,
+                           "shm")
+    return _caps.meet(shm_floor, TcpShmWindow.CAPS, "routed")
+
+
+RoutedWindow.CAPS = _static_floor_caps()
+# a routed window never fuses a scale factor (the TCP leg cannot)
+RoutedWindow.supports_scale = False

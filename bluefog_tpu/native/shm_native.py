@@ -33,6 +33,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from bluefog_tpu.native import get_lib
+from bluefog_tpu.native import capabilities as _caps
 from bluefog_tpu.telemetry import registry as _telemetry
 
 _DTYPE_CODES = {np.dtype(np.float32): 1, np.dtype(np.float64): 2}
@@ -348,6 +349,17 @@ class NativeShmWindow:
     #: islands.py keys off this to route scaled deposits / fused combines
     #: through the transport instead of staging temporaries.
     supports_scale = True
+
+    CAPS = _caps.TransportCaps(
+        name="shm-native",
+        fused_accumulate=True,
+        fused_scale=True,       # == supports_scale
+        fused_combine=True,     # combine() / update_fused()
+        zero_copy_collect=True,  # O(1) drained-marker drain
+        chunked_streaming=True,  # per-chunk seqlock ring
+        wire_quantization=False,  # same-host memcpy, nothing to quantize
+        resume=False,            # shared memory has no sessions to resume
+    )
 
     def __init__(self, job: str, name: str, rank: int, nranks: int,
                  maxd: int, shape: Tuple[int, ...], dtype,
@@ -1081,6 +1093,17 @@ class FallbackShmWindow:
     _HDR = 16  # per-slot: [version u64][p f64]
 
     supports_scale = True
+
+    CAPS = _caps.TransportCaps(
+        name="shm-fallback",
+        fused_accumulate=True,
+        fused_scale=True,        # == supports_scale
+        fused_combine=True,      # locked two-pass combine()
+        zero_copy_collect=False,  # collect memsets the payload
+        chunked_streaming=False,  # whole-slot lockf, no chunk ring
+        wire_quantization=False,
+        resume=False,
+    )
 
     def __init__(self, job: str, name: str, rank: int, nranks: int,
                  maxd: int, shape: Tuple[int, ...], dtype,

@@ -66,6 +66,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from bluefog_tpu.common.logging_util import logger
+from bluefog_tpu.native import capabilities as _caps
 from bluefog_tpu.native import wire_codec
 from bluefog_tpu.resilience.detector import PeerTimeoutError
 from bluefog_tpu.telemetry import registry as _telemetry
@@ -1261,6 +1262,21 @@ class TcpShmJob:
 
 class TcpShmWindow:
     """Window handle with the shm-window interface over the TCP runtime."""
+
+    #: no fused scale: ``write`` has no ``scale`` kwarg — islands
+    #: pre-multiplies before a TCP deposit (capability-linted).
+    supports_scale = False
+
+    CAPS = _caps.TransportCaps(
+        name="tcp",
+        fused_accumulate=True,
+        fused_scale=False,       # == supports_scale
+        fused_combine=False,     # no combine()/update_fused()
+        zero_copy_collect=True,  # collect swaps the slot buffer, O(1)
+        chunked_streaming=True,  # deposit_chunked + credit window
+        wire_quantization=True,  # BFTPU_WIRE_DTYPE + EF residual
+        resume=True,             # session resume replays _IDEMPOTENT_OPS
+    )
 
     def __init__(self, job: str, name: str, rank: int, nranks: int,
                  maxd: int, shape, dtype, coord: str):

@@ -40,6 +40,7 @@ import math
 from contextlib import contextmanager
 from typing import Dict, List, Optional, Set, Tuple
 
+from bluefog_tpu.native import capabilities as _caps
 from bluefog_tpu.resilience.join import MembershipBoard
 from bluefog_tpu.sim.clock import Clock, resolve_clock
 from bluefog_tpu.sim.events import EventLoop
@@ -87,6 +88,17 @@ class SimJobView:
 
 class SimTransport:
     """See module docstring."""
+
+    CAPS = _caps.TransportCaps(
+        name="sim",
+        fused_accumulate=True,   # deposit folds (x, p) into the slot
+        fused_scale=False,       # campaigns pre-weight their deposits
+        fused_combine=False,     # collect returns scalars; nothing to fuse
+        zero_copy_collect=True,  # collect IS the atomic drain, no copy
+        chunked_streaming=False,  # virtual wire delivers whole payloads
+        wire_quantization=False,
+        resume=False,            # a severed sim edge stays severed
+    )
 
     def __init__(self, loop: EventLoop, clock: Clock):
         self.loop = loop
