@@ -9,6 +9,9 @@
 #                        run only the rule families gating the listed files
 #                        (see conformance.FAMILY_MAP) — the pre-commit gate
 #   make test            tier-1 pytest (not slow)
+#   make distrib         distribution-plane gate: the distrib rule family
+#                        (pinned tree campaigns + kill/delta models) plus the
+#                        loopback fan-out bench arm (benchmarks/serving.py)
 #
 # All targets force the CPU backend so they run on any host.
 
@@ -17,7 +20,7 @@ ENV     := JAX_PLATFORMS=cpu
 PYTEST  := $(ENV) $(PY) -m pytest tests/ -q -m 'not slow' \
            --continue-on-collection-errors -p no:cacheprovider
 
-.PHONY: verify analyze selftest changed test
+.PHONY: verify analyze selftest changed test distrib
 
 verify: selftest analyze test
 
@@ -33,3 +36,7 @@ changed:
 
 test:
 	$(PYTEST)
+
+distrib:
+	$(ENV) $(PY) -m bluefog_tpu.analysis --family distrib
+	$(ENV) $(PY) benchmarks/serving.py distrib

@@ -588,6 +588,22 @@ def main():
             print(json.dumps(srate), file=sys.stderr)
         except Exception as e:  # noqa: BLE001
             print(f"serving phase failed: {e!r}", file=sys.stderr)
+    dst = None
+    if time.perf_counter() - t_start < budget_s:
+        try:
+            # distribution-plane headline (docs/SERVING.md "Cross-host
+            # distribution"): one publisher feeds K loopback replicas
+            # through the bounded-degree delta fan-out tree; median
+            # publish-complete to ALL-replicas-swapped latency, plus
+            # the steady-state one-behind delta bytes over the raw
+            # snapshot bytes.  Gate: delta ratio < 0.6 at bf16; tree
+            # depth <= floor(log4 K)+1 and publisher feed sockets <=
+            # fanout are asserted inside the arm.
+            from serving import measure_distrib
+            dst = measure_distrib()
+            print(json.dumps(dst), file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"distrib phase failed: {e!r}", file=sys.stderr)
     wcr = None
     if time.perf_counter() - t_start < budget_s:
         try:
@@ -733,6 +749,17 @@ def main():
     if srate is not None:
         headline["serve_rate_steps_s"] = srate["value"]
         headline["serve_rate_metric"] = srate["metric"]
+    if dst is not None:
+        headline["distrib_all_swap_ms"] = dst["value"]
+        headline["distrib_metric"] = dst["metric"]
+        # the acceptance gate (< 0.6 at bf16): steady-state wire bytes
+        # a one-behind replica pulls / raw f32 snapshot bytes, every
+        # chunk dirty — the dirty map only improves on this
+        # (sparse_delta_ratio_f32 in the arm's own JSON line)
+        headline["distrib_delta_ratio"] = dst["delta_ratio_bf16"]
+        headline["distrib_all_swap_by_fleet_ms"] = dst["all_swap_ms"]
+        headline["distrib_tree_depth"] = dst["tree_depth"]
+        headline["distrib_publisher_feeds"] = dst["publisher_feeds"]
     if wcr is not None:
         headline["wire_compression_ratio"] = wcr["value"]
         headline["wire_compression_metric"] = wcr["metric"]

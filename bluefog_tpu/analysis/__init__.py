@@ -65,6 +65,15 @@ consumers (CLI, pytest, CI):
   orphaned-publisher campaign showing the handoff, and an exhaustive
   double-buffer interleaving model proves a completed read only ever
   returns a committed version's canonical bytes;
+- **distrib** (:mod:`.distrib_rules`) — the snapshot distribution
+  plane: exhaustive kill/re-parent sequences over the production
+  fan-out tree math stay connected, acyclic and degree-capped at
+  logarithmic depth, dirty-map deltas compose to the full canonical
+  snapshot bit for bit at every codec and lag (degrading to a full
+  resync past the horizon, with incomplete deltas un-installable),
+  and pinned distribution campaigns (interior relay killed mid-fan-out,
+  join storm mid-rollout) keep the tree-validity and staleness-SLO
+  standing invariants silent while subtrees re-parent and converge;
 - **lab** (:mod:`.lab_rules`) — the convergence observatory's frozen
   sweep artifact: schema-valid, cell fits refittable from their own
   series, scaling laws non-increasing in fleet size, measured rates
@@ -112,6 +121,7 @@ from bluefog_tpu.analysis.engine import (  # noqa: F401
 from bluefog_tpu.analysis import (  # noqa: F401
     adaptive_rules,
     conformance,
+    distrib_rules,
     epoch_rules,
     fixtures,
     hlo_corpus,

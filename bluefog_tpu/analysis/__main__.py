@@ -96,6 +96,8 @@ def main(argv=None) -> int:
             "introspect": "~2 s", "sim": "~10 s (pinned fault campaigns)",
             "partition": "~10 s (pinned partition campaigns)",
             "serve": "~10 s (pinned serve campaigns + buffer model)",
+            "distrib": "~15 s (pinned tree campaigns + exhaustive "
+                       "kill/delta models)",
             "lab": "~5 s (frozen sweep artifact re-derivation)",
             "transport": "<1 s (spec table pins + capability lint)",
             "conformance": "~5 s (differential transports vs reference; "
@@ -187,6 +189,26 @@ def main(argv=None) -> int:
         if stale:
             print(f"self-test FAILED: serve campaign(s) failed {stale}")
             return 1
+        # distrib arm: acceptance-size distribution-tree campaigns
+        # (relay kills + join storm mid-rollout at >= 64 ranks) must
+        # re-parent cleanly, converge, and replay bit-identically
+        from bluefog_tpu.analysis import distrib_rules
+
+        stalled = []
+        for label, res, findings in (
+                distrib_rules.selftest_distrib_campaigns()):
+            ok = not findings
+            print(f"  {label:<36s} "
+                  f"{'clean' if ok else 'VIOLATED'} "
+                  f"(events={res.events}, digest={res.digest[:12]})")
+            for f in findings:
+                print(f"    {f}")
+            if not ok:
+                stalled.append(label)
+        if stalled:
+            print(f"self-test FAILED: distrib campaign(s) failed "
+                  f"{stalled}")
+            return 1
         # lab arm: every claim the frozen sweep artifact makes must
         # re-derive from its own raw data (python -m bluefog_tpu.lab
         # --check runs the same checks standalone)
@@ -243,7 +265,9 @@ def main(argv=None) -> int:
         print(f"self-test OK: all {len(fixtures.FIXTURES)} seeded bugs "
               f"caught, {len(sim_rules.SELFTEST_PINS)} pinned campaigns "
               f"+ {len(partition_rules.PARTITION_PINS)} partition "
-              f"+ {len(serve_rules.SERVE_PINS)} serve campaigns clean, "
+              f"+ {len(serve_rules.SERVE_PINS)} serve "
+              f"+ {len(distrib_rules.DISTRIB_PINS)} distrib campaigns "
+              f"clean, "
               f"lab artifact verified ({ncells} cells), transports "
               f"conformant, unified explorer subsumes the legacy models")
         return 0

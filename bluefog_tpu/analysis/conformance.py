@@ -815,6 +815,15 @@ FAMILY_MAP: Dict[str, Tuple[str, ...]] = {
                                        "interleave"),
     "bluefog_tpu/islands.py": ("protocol", "transport", "wire"),
     "bluefog_tpu/serving/region.py": ("serve", "interleave"),
+    # the snapshot distribution plane: tree math, delta codec and the
+    # feed protocol are all gated by the distrib family (the codec
+    # additionally by wire — deltas ride the wire_codec chunks)
+    "bluefog_tpu/serve/distrib/__init__.py": ("distrib",),
+    "bluefog_tpu/serve/distrib/tree.py": ("distrib",),
+    "bluefog_tpu/serve/distrib/delta.py": ("distrib", "wire"),
+    "bluefog_tpu/serve/distrib/feed.py": ("distrib", "wire"),
+    "bluefog_tpu/serve/distrib/sub.py": ("distrib", "serve"),
+    "bluefog_tpu/analysis/distrib_rules.py": ("distrib",),
 }
 
 

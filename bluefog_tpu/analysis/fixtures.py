@@ -612,6 +612,69 @@ def _serve_torn_read_model() -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# distrib fixtures: seeded distribution-plane bugs the standing
+# invariants (and the delta-completeness audit) must catch
+# ---------------------------------------------------------------------------
+
+
+def _distrib_degree_overflow() -> List[Finding]:
+    """A distribution campaign whose tree repair ignores the fan-out
+    cap (``distrib_degree_overflow``): a relay death dumps every
+    orphan onto the shallowest relay, and the tree-validity standing
+    invariant must flag the overloaded node."""
+    from bluefog_tpu.analysis import distrib_rules, sim_rules
+    from bluefog_tpu.sim.schedule import Fault, FaultSchedule
+
+    _cfg, _sched, res = distrib_rules.distrib_campaign(
+        16, 24, 3, serve_replicas=13, distrib_fanout=3, distrib_slo=0,
+        schedule=FaultSchedule([Fault(kind="serve_kill", step=2,
+                                      rank=1)]),
+        debug_bugs=("distrib_degree_overflow",))
+    return sim_rules.campaign_findings(
+        res, "fixture[distrib-degree-overflow]")
+
+
+def _distrib_stalled_subtree() -> List[Finding]:
+    """A distribution campaign where a dead relay's children never
+    re-parent (``distrib_stall``): the orphaned subtree stops adopting
+    versions while the publisher keeps committing, and the
+    staleness-SLO standing invariant must flag the growing lag."""
+    from bluefog_tpu.analysis import distrib_rules, sim_rules
+    from bluefog_tpu.sim.schedule import Fault, FaultSchedule
+
+    _cfg, _sched, res = distrib_rules.distrib_campaign(
+        16, 40, 3, distrib_slo=4,
+        schedule=FaultSchedule([Fault(kind="serve_kill", step=2,
+                                      rank=0)]),
+        debug_bugs=("distrib_stall",))
+    return sim_rules.campaign_findings(
+        res, "fixture[distrib-stalled-subtree]")
+
+
+def _distrib_version_regress() -> List[Finding]:
+    """A distribution campaign whose publisher handoff restarts the
+    version word at 1 (``serve_version_reset`` with the tree armed):
+    the serve-monotone standing invariant must flag the regression
+    before it propagates down the tree."""
+    from bluefog_tpu.analysis import distrib_rules, sim_rules
+
+    _cfg, _sched, res = distrib_rules.distrib_campaign(
+        16, 24, 3, debug_bugs=("serve_version_reset",))
+    return sim_rules.campaign_findings(
+        res, "fixture[distrib-version-regress]")
+
+
+def _distrib_stale_delta() -> List[Finding]:
+    """A feed that silently drops a dirty chunk from its delta: the
+    delta-completeness audit (CRC gate bypassed, so the audit itself
+    must notice) flags bytes that no longer compose to the full
+    canonical snapshot."""
+    from bluefog_tpu.analysis import distrib_rules
+
+    return distrib_rules.stale_delta_findings()
+
+
+# ---------------------------------------------------------------------------
 # lab fixtures: mutate the REAL frozen sweep artifact (same rationale as
 # the plan fixtures — a schema change that disarms a rule breaks these)
 # ---------------------------------------------------------------------------
@@ -783,6 +846,13 @@ FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
     "serve-version-reset": _serve_version_reset,
     "serve-torn-swap": _serve_torn_swap,
     "serve-torn-read-model": _serve_torn_read_model,
+    # distrib family: an uncapped tree repair, a stalled orphan
+    # subtree, a regressing publisher handoff, a dirty chunk dropped
+    # from a delta
+    "distrib-degree-overflow": _distrib_degree_overflow,
+    "distrib-stalled-subtree": _distrib_stalled_subtree,
+    "distrib-version-regress": _distrib_version_regress,
+    "distrib-stale-delta": _distrib_stale_delta,
     # lab family: tampered sweep artifacts the observatory must reject
     "lab-corrupted-fit": _lab_corrupted_fit,
     "lab-tampered-rate": _lab_tampered_rate,

@@ -117,11 +117,18 @@ def render(snap: dict, rates: Dict[int, float]) -> str:
         conv_s = f"{cerr:.1e}" if cround >= 0 and cerr >= 0.0 else "—"
         # serving plane (statuspage v5): the snapshot version this rank
         # publishes/serves; replicas append their lag ("v3+2" = serving
-        # v3, 2 committed versions behind); "—" = not a serve rank
+        # v3, 2 committed versions behind); "—" = not a serve rank.
+        # A distribution-tree replica (v6) appends its slot and feed
+        # edge: "v3 s4<1" = slot 4 fed by slot 1, "<P" = publisher-fed
         sv = page.get("serve", {})
         sver, slag = sv.get("version", -1), sv.get("lag", -1)
         serve_s = "—" if sver < 0 else (
             f"v{sver}" + (f"+{slag}" if slag > 0 else ""))
+        dv = page.get("distrib", {})
+        if sver >= 0 and dv.get("slot", -1) >= 0:
+            par = dv.get("parent", -1)
+            serve_s += f" s{dv['slot']}<" + (
+                "P" if par < 0 else str(par))
         # an ORPHAN rank quiesced on quorum loss — the page freezes at
         # the denial, so the state outranks whatever op came last
         last_op = "ORPHAN" if page.get("orphan") else page["last_op"]
@@ -133,11 +140,17 @@ def render(snap: dict, rates: Dict[int, float]) -> str:
             f"{serve_s:>9} {queue:<14} {holds:<8} {edges}")
     if snap.get("serve"):
         lines.append("")
+        # tree replicas append "slot<parent" ("<P" = publisher-fed),
+        # so one line shows the whole distribution fan-out
         lines.append(
             f"serving: committed v{snap.get('serve_published', -1)}; " +
-            ", ".join(f"r{r} v{v['version']} lag {max(0, v['lag'])}"
-                      for r, v in sorted(snap["serve"].items(),
-                                         key=lambda kv: int(kv[0]))))
+            ", ".join(
+                f"r{r} v{v['version']} lag {max(0, v['lag'])}" + (
+                    f" s{v['slot']}<" + ("P" if v.get("parent", -1) < 0
+                                         else str(v["parent"]))
+                    if v.get("slot", -1) >= 0 else "")
+                for r, v in sorted(snap["serve"].items(),
+                                   key=lambda kv: int(kv[0]))))
     if snap.get("orphans"):
         lines.append("")
         lines.append(f"ORPHANED (quorum lost, quiesced): "

@@ -29,9 +29,9 @@ from typing import Dict, List, Optional, Tuple
 
 from bluefog_tpu.native import shm_native
 
-STATUS_SCHEMA = "bftpu-statuspage/5"
+STATUS_SCHEMA = "bftpu-statuspage/6"
 STATUS_MAGIC = 0x42465350  # "BFSP"
-STATUS_VERSION = 5
+STATUS_VERSION = 6
 
 #: Page layout: header (magic u32, version u32, seq u64), fixed block,
 #: then up to MAX_EDGES edge records; the whole page is padded to
@@ -44,8 +44,12 @@ STATUS_VERSION = 5
 #: (serve_version + serve_lag — the snapshot version a publisher last
 #: committed / a replica currently serves, and how many committed
 #: versions the replica trails; -1/-1 = not part of the serve plane,
-#: see docs/SERVING.md).  Readers still decode v1..v4 pages from live
-#: older writers.
+#: see docs/SERVING.md); v6 appends the distribution tree
+#: (distrib_slot + distrib_parent — this replica's slot in the fan-out
+#: tree and the slot it feeds from, -1 parent = the publisher itself;
+#: slot -1 = not attached through the distribution plane, see
+#: docs/SERVING.md "Cross-host distribution").  Readers still decode
+#: v1..v5 pages from live older writers.
 _HEAD = struct.Struct("<IIQ")                 # magic, version, seq
 _FIXED_V1 = struct.Struct("<iiiiQQQdd16sdddd")  # rank, nranks, pid, n_edges,
 #                                                 step, epoch, op_id,
@@ -55,8 +59,10 @@ _FIXED_V2 = struct.Struct("<iiiiQQQdd16sddddi16s")  # ... + qdepth, inflight
 _FIXED_V3 = struct.Struct("<iiiiQQQdd16sddddi16sdq")  # ... + conv_err,
 #                                                         conv_round
 _FIXED_V4 = struct.Struct("<iiiiQQQdd16sddddi16sdqi")  # ... + flags
-_FIXED = struct.Struct("<iiiiQQQdd16sddddi16sdqiqq")   # ... + serve_version,
-#                                                          serve_lag
+_FIXED_V5 = struct.Struct("<iiiiQQQdd16sddddi16sdqiqq")  # ... +
+#                                               serve_version, serve_lag
+_FIXED = struct.Struct("<iiiiQQQdd16sddddi16sdqiqqii")   # ... +
+#                                               distrib_slot, distrib_parent
 _EDGE = struct.Struct("<iid")                 # peer_global, state, deadline_s
 MAX_EDGES = 32
 PAGE_BYTES = 1024
@@ -101,7 +107,8 @@ class StatusPage:
                 edges=(), qdepth: int = -1, inflight: str = "",
                 conv_err: float = -1.0, conv_round: int = -1,
                 flags: int = 0, serve_version: int = -1,
-                serve_lag: int = -1) -> None:
+                serve_lag: int = -1, distrib_slot: int = -1,
+                distrib_parent: int = -1) -> None:
         """Seqlocked single-writer update of the whole page.
 
         ``edges`` is an iterable of ``(peer_global, state_code,
@@ -112,7 +119,10 @@ class StatusPage:
         the convergence probe (round -1 = probe off); ``flags`` is the
         v4 bit set (``FLAG_ORPHAN`` = quorum lost, rank quiesced);
         ``serve_version``/``serve_lag`` are the v5 serving plane
-        (-1 = this rank neither publishes nor serves snapshots)."""
+        (-1 = this rank neither publishes nor serves snapshots);
+        ``distrib_slot``/``distrib_parent`` are the v6 distribution
+        tree (slot -1 = not attached through the distribution plane,
+        parent -1 = fed straight by the publisher)."""
         mm = self._seg._mm
         led = ledger or {}
         ed = list(edges)[:MAX_EDGES]
@@ -131,7 +141,8 @@ class StatusPage:
             int(qdepth),
             str(inflight).encode("utf-8", "replace")[:16],
             float(conv_err), int(conv_round), int(flags),
-            int(serve_version), int(serve_lag))
+            int(serve_version), int(serve_lag),
+            int(distrib_slot), int(distrib_parent))
         off = _HEAD.size + _FIXED.size
         for peer, state, deadline in ed:
             _EDGE.pack_into(mm, off, int(peer), int(state), float(deadline))
@@ -147,7 +158,7 @@ def _decode(buf: bytes) -> Dict[str, object]:
     magic, version, seq = _HEAD.unpack_from(buf, 0)
     if magic != STATUS_MAGIC:
         raise ValueError(f"not a status page (magic 0x{magic:08x})")
-    if version not in (1, 2, 3, 4, STATUS_VERSION):
+    if version not in (1, 2, 3, 4, 5, STATUS_VERSION):
         raise ValueError(f"unsupported status-page version {version}")
     if version == 1:
         # a live v1 writer (mid-upgrade fleet): no progress-engine block
@@ -158,6 +169,7 @@ def _decode(buf: bytes) -> Dict[str, object]:
         conv_err, conv_round = -1.0, -1
         flags = 0
         serve_version, serve_lag = -1, -1
+        distrib_slot, distrib_parent = -1, -1
         fixed_size = _FIXED_V1.size
     elif version == 2:
         # a live v2 writer: progress block, no convergence word
@@ -167,6 +179,7 @@ def _decode(buf: bytes) -> Dict[str, object]:
         conv_err, conv_round = -1.0, -1
         flags = 0
         serve_version, serve_lag = -1, -1
+        distrib_slot, distrib_parent = -1, -1
         fixed_size = _FIXED_V2.size
     elif version == 3:
         # a live v3 writer: convergence word, no flags word
@@ -175,6 +188,7 @@ def _decode(buf: bytes) -> Dict[str, object]:
          conv_err, conv_round) = _FIXED_V3.unpack_from(buf, _HEAD.size)
         flags = 0
         serve_version, serve_lag = -1, -1
+        distrib_slot, distrib_parent = -1, -1
         fixed_size = _FIXED_V3.size
     elif version == 4:
         # a live v4 writer: flags word, no serving plane
@@ -183,12 +197,24 @@ def _decode(buf: bytes) -> Dict[str, object]:
          conv_err, conv_round, flags) = _FIXED_V4.unpack_from(
             buf, _HEAD.size)
         serve_version, serve_lag = -1, -1
+        distrib_slot, distrib_parent = -1, -1
         fixed_size = _FIXED_V4.size
+    elif version == 5:
+        # a live v5 writer: serving plane, no distribution tree
+        (rank, nranks, pid, n_edges, step, epoch, op_id, wall_ts, mono_ts,
+         last_op, dep, col, drn, pend, qdepth, inflight,
+         conv_err, conv_round, flags,
+         serve_version, serve_lag) = _FIXED_V5.unpack_from(
+            buf, _HEAD.size)
+        distrib_slot, distrib_parent = -1, -1
+        fixed_size = _FIXED_V5.size
     else:
         (rank, nranks, pid, n_edges, step, epoch, op_id, wall_ts, mono_ts,
          last_op, dep, col, drn, pend, qdepth, inflight,
          conv_err, conv_round, flags,
-         serve_version, serve_lag) = _FIXED.unpack_from(buf, _HEAD.size)
+         serve_version, serve_lag,
+         distrib_slot, distrib_parent) = _FIXED.unpack_from(
+            buf, _HEAD.size)
         fixed_size = _FIXED.size
     edges: List[Dict[str, object]] = []
     off = _HEAD.size + fixed_size
@@ -242,6 +268,13 @@ def _decode(buf: bytes) -> Dict[str, object]:
         "serve": {
             "version": int(serve_version),
             "lag": int(serve_lag),
+        },
+        # the distribution tree (docs/SERVING.md "Cross-host
+        # distribution"): slot -1 = not attached through the distrib
+        # plane; parent -1 = fed straight by the publisher
+        "distrib": {
+            "slot": int(distrib_slot),
+            "parent": int(distrib_parent),
         },
         "edges": edges,
     }
@@ -335,9 +368,18 @@ def collect(job: str) -> Dict[str, object]:
     orphans = sorted(r for r, p in fleet.items() if p.get("orphan"))
     # the serving plane: every rank that publishes/serves snapshots
     # (training publishers report lag 0; replicas their actual trail)
-    serve = {str(r): p["serve"] for r, p in sorted(fleet.items())
-             if "error" not in p
-             and p.get("serve", {}).get("version", -1) >= 0}
+    serve = {}
+    for r, p in sorted(fleet.items()):
+        if "error" in p or p.get("serve", {}).get("version", -1) < 0:
+            continue
+        ent = dict(p["serve"])
+        d = p.get("distrib", {})
+        if d.get("slot", -1) >= 0:
+            # attached through the distribution tree: report its slot
+            # and the slot it feeds from (-1 = the publisher)
+            ent["slot"] = int(d["slot"])
+            ent["parent"] = int(d["parent"])
+        serve[str(r)] = ent
     return {
         "schema": "bftpu-top/1",
         "job": job,

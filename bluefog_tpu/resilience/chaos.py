@@ -52,6 +52,7 @@ __all__ = [
     "schedule_partition",
     "schedule_serve_kill",
     "schedule_serve_pub_kill",
+    "schedule_distrib_kill",
     "schedule_to_json",
     "apply_schedule_json",
     "clear_schedule",
@@ -79,6 +80,8 @@ _SERVE_KILL_SWAP = "BFTPU_CHAOS_SERVE_KILL_SWAP"
 _SERVE_KILL_STOP = "BFTPU_CHAOS_SERVE_KILL_STOP"
 _SERVE_PUB_KILL_PUBLISH = "BFTPU_CHAOS_SERVE_PUB_KILL_PUBLISH"
 _SERVE_PUB_KILL_PHASE = "BFTPU_CHAOS_SERVE_PUB_KILL_PHASE"
+_DISTRIB_KILL_RELAY = "BFTPU_CHAOS_DISTRIB_KILL_RELAY"
+_DISTRIB_KILL_SYNC = "BFTPU_CHAOS_DISTRIB_KILL_SYNC"
 
 _ALL_KEYS = (_KILL_RANK, _KILL_STEP, _DELAY_S,
              _JOIN_RANK, _JOIN_STEP,
@@ -86,7 +89,8 @@ _ALL_KEYS = (_KILL_RANK, _KILL_STEP, _DELAY_S,
              _SLOW_RANK, _SLOW_STEP, _SLOW_S, _SLOW_STOP,
              _PARTITION_GROUP, _PARTITION_STEP, _PARTITION_STOP,
              _SERVE_KILL_REPLICA, _SERVE_KILL_SWAP, _SERVE_KILL_STOP,
-             _SERVE_PUB_KILL_PUBLISH, _SERVE_PUB_KILL_PHASE)
+             _SERVE_PUB_KILL_PUBLISH, _SERVE_PUB_KILL_PHASE,
+             _DISTRIB_KILL_RELAY, _DISTRIB_KILL_SYNC)
 
 # sim-campaign knobs (bluefog_tpu/sim/__main__.py reads these as CLI
 # defaults) — scrubbed by clear_schedule() alongside the chaos keys,
@@ -111,6 +115,14 @@ _LAB_KEYS = ("BFTPU_LAB_PROBE", "BFTPU_LAB_AUTO_TOPOLOGY",
 _SERVE_KEYS = ("BFTPU_SERVE_MAX_LAG", "BFTPU_SERVE_STALE_POLICY",
                "BFTPU_SERVE_RETRIES", "BFTPU_SERVE_BACKOFF_S",
                "BFTPU_SERVE_REPLICAS")
+
+# distribution-plane knobs (bluefog_tpu.serve.distrib): a stale fanout
+# reshapes the next fleet's tree, a stale horizon flips delta vs
+# full-resync paths, and the BFTPU_CHAOS_DISTRIB_* kill schedules are
+# literal fault schedules — all scrubbed with the rest
+_DISTRIB_KEYS = ("BFTPU_DISTRIB_FANOUT", "BFTPU_DISTRIB_HORIZON",
+                 "BFTPU_DISTRIB_CHUNK_KB", "BFTPU_DISTRIB_TIMEOUT_S",
+                 "BFTPU_DISTRIB_RETRIES")
 
 # injectable clock (sim/clock.py seam) for the delay/straggler sleeps;
 # process-level signals (suspend_self) always use wall time — you
@@ -275,6 +287,24 @@ def schedule_serve_pub_kill(env: dict, publish: int,
     return env
 
 
+def schedule_distrib_kill(env: dict, relay: Optional[int] = None,
+                          sync: Optional[int] = None,
+                          n: int = 1) -> dict:
+    """Publish a DISTRIBUTION-TREE kill schedule (value format
+    ``"replica_id:n"``).  ``relay`` SIGKILLs that subscriber right
+    after it installs its ``n``-th generation — its committed store
+    flipped (children may already be pulling the new version) but its
+    own replica never swapped: mid-fanout relay death, the subtree
+    must re-parent.  ``sync`` SIGKILLs the subscriber mid-delta — the
+    stream received but the staged generation NOT yet flipped: the
+    previous version must keep serving."""
+    if relay is not None:
+        env[_DISTRIB_KILL_RELAY] = f"{int(relay)}:{int(n)}"
+    if sync is not None:
+        env[_DISTRIB_KILL_SYNC] = f"{int(sync)}:{int(n)}"
+    return env
+
+
 def schedule_to_json() -> str:
     """Serialize the calling process's env-published chaos schedule to
     the shared fault-schedule JSON (see
@@ -301,7 +331,8 @@ def clear_schedule() -> None:
     kill, join, and suspend schedules alike (a stale key would replay
     the fault in the next test's workers) — plus the sim-campaign,
     lab, and serving-plane keys, which are schedules by another name."""
-    for k in _ALL_KEYS + _SIM_KEYS + _LAB_KEYS + _SERVE_KEYS:
+    for k in _ALL_KEYS + _SIM_KEYS + _LAB_KEYS + _SERVE_KEYS \
+            + _DISTRIB_KEYS:
         os.environ.pop(k, None)
 
 

@@ -679,6 +679,17 @@ def main(argv=None) -> int:
         "(docs/SERVING.md)",
     )
     parser.add_argument(
+        "--serve-remote",
+        default=None,
+        metavar="HOST:PORT",
+        help="islands mode, with --serve-replicas: attach the replicas "
+        "over TCP through the snapshot distribution tree rooted at the "
+        "given publisher feed address, instead of the local shm region "
+        "— the cross-host read path (each replica joins the tree, "
+        "feeds off its assigned parent, and relays to its children; "
+        "docs/SERVING.md, 'Cross-host distribution')",
+    )
+    parser.add_argument(
         "--attach",
         default=None,
         metavar="JOB",
@@ -718,11 +729,15 @@ def main(argv=None) -> int:
     if args.serve_replicas and not args.islands:
         parser.error("--serve-replicas requires --islands (the snapshot "
                      "region is published by an islands fleet)")
+    if args.serve_remote and not args.serve_replicas:
+        parser.error("--serve-remote requires --serve-replicas (it "
+                     "selects how those replicas attach)")
     env = build_env(args)
     if args.islands:
         return _run_islands(cmd, env, args.islands, args.job, hosts,
                             args.timeout, self_heal=args.self_heal,
-                            serve_replicas=args.serve_replicas)
+                            serve_replicas=args.serve_replicas,
+                            serve_remote=args.serve_remote)
     if args.np is not None and args.np > 1 and args.process_id is None:
         # `-np N` with no explicit process id: WE are the process launcher
         # (the reference's `bfrun -np N` execs mpirun which forks the ranks
@@ -857,7 +872,8 @@ def _collect_traces(env: dict, job: str) -> None:
 
 
 def _run_islands(cmd, env, nranks: int, job, hosts, timeout: float,
-                 self_heal: bool = False, serve_replicas: int = 0) -> int:
+                 self_heal: bool = False, serve_replicas: int = 0,
+                 serve_remote=None) -> int:
     """Fork N island processes (the `mpirun -np N` shape of the reference's
     launcher [U]).  With ``-H``, ranks spawn on their hosts over ssh and
     the hostmap/coordinator env is set so window traffic rides shared
@@ -918,9 +934,15 @@ def _run_islands(cmd, env, nranks: int, job, hosts, timeout: float,
         for i in range(serve_replicas):
             rc = dict(env)
             rc["BFTPU_SERVE_REPLICAS"] = str(serve_replicas)
-            serve_procs.append(subprocess.Popen(
-                [sys.executable, "-m", "bluefog_tpu.serve",
-                 "--job", job, "--replica-id", str(i)], env=rc))
+            serve_cmd = [sys.executable, "-m", "bluefog_tpu.serve",
+                         "--job", job, "--replica-id", str(i)]
+            if serve_remote:
+                # cross-host attach: feed through the distribution
+                # tree rooted at the publisher's feed address instead
+                # of the local shm region
+                rc["BFTPU_SERVE_REMOTE"] = serve_remote
+                serve_cmd += ["--remote", serve_remote]
+            serve_procs.append(subprocess.Popen(serve_cmd, env=rc))
         control = None
         try:
             if multi_host:

@@ -4,11 +4,18 @@
 island.  The loop is deliberately boring — poll, maybe swap, serve —
 because every interesting behavior (retry, staleness, chaos) lives in
 :class:`bluefog_tpu.serve.replica.Replica` where tests can reach it.
+
+``--remote host:port`` (or ``BFTPU_SERVE_REMOTE``) attaches through
+the snapshot distribution tree instead of the local shm region: the
+replica joins the publisher's coordinator, feeds off its assigned
+parent, and relays to its own children — the cross-host read path
+(docs/SERVING.md, "Cross-host distribution").
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
@@ -23,6 +30,13 @@ def main(argv=None) -> int:
                     "snapshot region.")
     ap.add_argument("--job", required=True, help="job name to subscribe to")
     ap.add_argument("--replica-id", type=int, default=0)
+    ap.add_argument("--remote", default=os.environ.get(
+        "BFTPU_SERVE_REMOTE", ""),
+        help="attach over TCP through the distribution tree "
+        "(publisher's host:port) instead of the local shm region")
+    ap.add_argument("--no-relay", action="store_true",
+                    help="remote mode: never relay to children "
+                    "(leaf-only subscriber)")
     ap.add_argument("--poll-s", type=float, default=0.02,
                     help="seconds between region polls")
     ap.add_argument("--steps", type=int, default=0,
@@ -31,7 +45,13 @@ def main(argv=None) -> int:
                     help="exit after this many seconds (0 = no limit)")
     args = ap.parse_args(argv)
 
-    rep = Replica(args.job, args.replica_id)
+    source = None
+    if args.remote:
+        from bluefog_tpu.serve.distrib import TcpSource
+
+        source = TcpSource(args.remote, replica_id=args.replica_id,
+                           relay=not args.no_relay)
+    rep = Replica(args.job, args.replica_id, source=source)
     t_end = time.monotonic() + args.duration_s if args.duration_s else None
     try:
         while True:
@@ -51,6 +71,8 @@ def main(argv=None) -> int:
     finally:
         print(f"[serve r{args.replica_id}] version={rep.version} "
               f"swaps={rep.swaps} steps={rep.serve_steps} lag={rep.lag}")
+        if source is not None:
+            source.close()
         rep.close()
     return 0
 

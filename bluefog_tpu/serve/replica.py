@@ -193,10 +193,17 @@ class Replica:
         if self._page is None:
             return
         cur = self._current
+        # a distribution-tree source (serve.distrib.TcpSource) exposes
+        # its slot/parent — surfaced on the page so bftpu-top draws
+        # the tree (slot -1 = shm-attached, not in the tree)
+        slot = getattr(self.source, "slot", None)
+        parent = getattr(self.source, "parent_slot", -1)
         self._page.publish(
             nranks=0, step=self.serve_steps,
             epoch=cur[1] if cur else 0, op_id=self.swaps,
-            last_op=op, serve_version=self.version, serve_lag=self.lag)
+            last_op=op, serve_version=self.version, serve_lag=self.lag,
+            distrib_slot=-1 if slot is None else int(slot),
+            distrib_parent=int(parent))
 
     # -- subscribe / swap --------------------------------------------------
 

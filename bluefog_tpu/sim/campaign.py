@@ -102,6 +102,23 @@ class SimConfig:
     # events: existing digests and repro files are unchanged.
     serve_every: int = 0
     serve_replicas: int = 0
+    # distribution tree (serve.distrib model): distrib_fanout > 0 (with
+    # the serve plane armed) organizes the replica models into a
+    # bounded-degree fan-out tree — each replica adopts a committed
+    # version only after its parent installed it plus a seeded per-edge
+    # latency, a dead parent re-parents the child via the same greedy
+    # repair the real coordinator runs (serve.distrib.tree.reassign),
+    # and tree validity (connected / acyclic / degree-capped) is
+    # checked after every distrib event.  distrib_slo > 0 additionally
+    # bounds per-replica staleness (versions behind the publisher) as a
+    # standing invariant.  distrib_join_round/N arm a join storm: N
+    # fresh replicas grafted into the tree at that round.  All default
+    # OFF — a distrib-disabled config logs zero new events, so existing
+    # digests and repro files are unchanged.
+    distrib_fanout: int = 0
+    distrib_slo: int = 0
+    distrib_join_round: int = 0
+    distrib_join_n: int = 0
     # plumbing
     max_events: int = 20_000_000
     journal_dir: Optional[str] = None
@@ -111,7 +128,11 @@ class SimConfig:
     # single-lineage invariant fires), serve_version_reset (a publisher
     # handoff restarts snapshot versions at 1 — the serve-monotone
     # invariant fires), serve_torn (replica swaps mix old and new
-    # buffer bytes — the serve-committed invariant fires)
+    # buffer bytes — the serve-committed invariant fires),
+    # distrib_degree_overflow (tree repair ignores the fan-out cap, so
+    # a re-parent overloads a relay — the tree-validity invariant
+    # fires), distrib_stall (children of a dead relay never re-parent —
+    # the staleness-SLO invariant fires)
     debug_bugs: Tuple[str, ...] = ()
     # convergence observatory (bluefog_tpu.lab): record per-rank
     # successive-estimate differences each round.  The trace rides in

@@ -180,6 +180,24 @@ class SimFleet:
         self._serve_version = 0
         self._serve_pub_count = 0
         self._serve_replicas: Dict[int, dict] = {}
+        # distribution tree (cfg.distrib_fanout > 0 with the serve
+        # plane armed): replica id -> parent replica id
+        # (serve.distrib.tree.PUBLISHER = fed by the region directly)
+        # plus a per-feed-edge propagation latency.  The latency stream
+        # is DEDICATED (seed ^ 0xD157), so arming the tree never
+        # perturbs the seeded streams existing digests derive from.
+        self._distrib_fanout = (
+            int(getattr(cfg, "distrib_fanout", 0) or 0)
+            if self._serve_every > 0 and self._serve_replica_n > 0
+            else 0)
+        self._distrib_parents: Dict[int, int] = {}
+        self._distrib_lat: Dict[int, float] = {}
+        self._distrib_rng = random.Random(int(cfg.seed) ^ 0xD157)
+        self._distrib_reparents = 0
+        self._distrib_joins = 0
+        #: committed version -> virtual commit instant (feeds the
+        #: per-edge propagation gate; never reaches the event log)
+        self._serve_commit_t: Dict[int, float] = {}
         # faults indexed by (victim global rank, step); joins and
         # partitions fire on their own timers (no single victim);
         # serve faults key on replica id / publish ordinal instead of
@@ -298,11 +316,22 @@ class SimFleet:
             for i in range(self._serve_replica_n):
                 self._serve_replicas[i] = {
                     "version": 0, "payload": None, "swaps": 0,
-                    "steps": 0, "killed": False, "fired": False}
+                    "steps": 0, "killed": False, "fired": False,
+                    "install_t": 0.0}
+                if self._distrib_fanout > 0:
+                    from bluefog_tpu.serve.distrib import tree as _dtree
+                    self._distrib_parents[i] = _dtree.parent_of(
+                        i, self._distrib_fanout)
+                    self._distrib_lat[i] = self._distrib_edge_latency()
                 off = 0.0 if getattr(cfg, "lockstep", False) \
                     else ((1000 + i) * 37 % 101) / 101.0
                 self.loop.at(_T0 + off * cfg.round_period,
                              self._serve_replica_event(i))
+            jr = int(getattr(cfg, "distrib_join_round", 0) or 0)
+            jn = int(getattr(cfg, "distrib_join_n", 0) or 0)
+            if self._distrib_fanout > 0 and jr > 0 and jn > 0:
+                self.loop.at(_T0 + jr * cfg.round_period,
+                             self._distrib_join_storm_event(jn))
         for f in self._join_faults:
             self.loop.at(_T0 + f.step * cfg.round_period,
                          self._joiner_event(f))
@@ -888,6 +917,7 @@ class SimFleet:
             self._violate("serve-monotone", f"at publish: {err}", g)
         self._serve_version = max(self._serve_version, version)
         self._serve_committed.append((version, payload))
+        self._serve_commit_t[version] = self.loop.now
         aux = {"repaired": True} if repaired else {}
         self._log("serve_publish", g, version=version, **aux)
 
@@ -909,46 +939,38 @@ class SimFleet:
                 return
             # a respawned replica is a fresh incarnation: nothing
             # installed, version floor back at 0 (per-replica
-            # monotonicity is per incarnation, as in the real fleet)
+            # monotonicity is per incarnation, as in the real fleet);
+            # in a distribution tree it re-joins as a leaf (its old
+            # slot was reassigned away when it died)
             rep.update(version=0, payload=None, killed=False)
+            if (self._distrib_fanout > 0
+                    and i not in self._distrib_parents):
+                self._distrib_place(i)
             self._log("serve_replica_join", 1000 + i)
             self.loop.after(0.0, self._serve_replica_event(i))
         return fire
 
     def _serve_replica_step(self, i: int, rep: dict) -> None:
-        if self._serve_committed:
+        if self._distrib_fanout > 0:
+            # tree-fed: adopt only what has propagated down the feed
+            # edge, and only FORWARD (a re-parent under a lagging
+            # relay must not regress the served version — mirrors
+            # Replica.poll_swap's monotone skip)
+            avail = self._distrib_visible(i, rep)
+            if avail is not None and avail[0] > rep["version"]:
+                if not self._serve_replica_adopt(i, rep, *avail):
+                    return
+            slo = int(getattr(self.cfg, "distrib_slo", 0) or 0)
+            err = _inv.check_distrib_staleness(
+                i, self._serve_version - rep["version"], slo)
+            if err:
+                self._violate("distrib-staleness", err, 1000 + i)
+        elif self._serve_committed:
             version, payload = self._serve_committed[-1]
             if version != rep["version"]:
-                f = self._serve_kill_faults.get(i)
-                if (f is not None and not rep["fired"]
-                        and rep["swaps"] + 1 == f.step):
-                    # die mid-swap (between the read and the flip):
-                    # nothing torn lands — the installed snapshot is
-                    # still whole when the process dies
-                    rep["fired"] = True
-                    rep["killed"] = True
-                    self._log("serve_replica_kill", 1000 + i,
-                              swap=rep["swaps"] + 1, version=version)
-                    if f.stop is not None:
-                        self.loop.at(
-                            _T0 + f.stop * self.cfg.round_period,
-                            self._serve_replica_join_event(i))
+                if not self._serve_replica_adopt(i, rep, version,
+                                                 payload):
                     return
-                err = _inv.check_serve_version_monotone(rep["version"],
-                                                        version)
-                if err:
-                    self._violate("serve-monotone",
-                                  f"replica {i}: {err}", 1000 + i)
-                new_payload = payload
-                if ("serve_torn" in self.cfg.debug_bugs
-                        and rep["payload"] is not None):
-                    # seeded bug: the swap mixes old and new buffer
-                    # bytes instead of flipping one whole generation
-                    new_payload = 0.5 * (rep["payload"] + payload)
-                rep["version"] = version
-                rep["payload"] = new_payload
-                rep["swaps"] += 1
-                self._log("serve_swap", 1000 + i, version=version)
         # serve from whatever is installed; every served byte must be
         # some committed snapshot (the torn-read invariant)
         if rep["payload"] is not None:
@@ -958,6 +980,142 @@ class SimFleet:
                 self._violate("serve-committed",
                               f"replica {i}: {err}", 1000 + i)
             rep["steps"] += 1
+
+    def _serve_replica_adopt(self, i: int, rep: dict, version: int,
+                             payload: float) -> bool:
+        """One hot-swap attempt at replica ``i``.  Returns False when
+        the chaos kill fault fires instead (the replica died mid-swap
+        and must not serve this step)."""
+        f = self._serve_kill_faults.get(i)
+        if (f is not None and not rep["fired"]
+                and rep["swaps"] + 1 == f.step):
+            # die mid-swap (between the read and the flip): nothing
+            # torn lands — the installed snapshot is still whole when
+            # the process dies
+            rep["fired"] = True
+            rep["killed"] = True
+            self._log("serve_replica_kill", 1000 + i,
+                      swap=rep["swaps"] + 1, version=version)
+            if self._distrib_fanout > 0:
+                self._distrib_on_kill(i)
+            if f.stop is not None:
+                self.loop.at(
+                    _T0 + f.stop * self.cfg.round_period,
+                    self._serve_replica_join_event(i))
+            return False
+        err = _inv.check_serve_version_monotone(rep["version"],
+                                                version)
+        if err:
+            self._violate("serve-monotone",
+                          f"replica {i}: {err}", 1000 + i)
+        new_payload = payload
+        if ("serve_torn" in self.cfg.debug_bugs
+                and rep["payload"] is not None):
+            # seeded bug: the swap mixes old and new buffer
+            # bytes instead of flipping one whole generation
+            new_payload = 0.5 * (rep["payload"] + payload)
+        rep["version"] = version
+        rep["payload"] = new_payload
+        rep["swaps"] += 1
+        rep["install_t"] = self.loop.now
+        self._log("serve_swap", 1000 + i, version=version)
+        return True
+
+    # -- distribution tree (serve.distrib model) ---------------------------
+
+    def _distrib_edge_latency(self) -> float:
+        lo, hi = self.cfg.latency_s
+        return self._distrib_rng.uniform(float(lo), float(hi))
+
+    def _distrib_check_tree(self, g: int) -> None:
+        err = _inv.check_distrib_tree(self._distrib_parents,
+                                      self._distrib_fanout)
+        if err:
+            self._violate("distrib-tree", err, g)
+
+    def _distrib_dead(self) -> set:
+        return {j for j, rj in self._serve_replicas.items()
+                if rj["killed"]}
+
+    def _distrib_visible(self, i: int, rep: dict):
+        """What replica ``i`` sees through its feed edge right now:
+        the newest snapshot its parent installed (or the newest region
+        commit when publisher-fed) whose per-edge propagation latency
+        has elapsed, or None while the edge has nothing newer."""
+        now = self.loop.now
+        lat = self._distrib_lat.get(i, 0.0)
+        parent = self._distrib_parents.get(i, -1)
+        if parent >= 0:
+            prep = self._serve_replicas.get(parent)
+            if prep is None or prep["killed"]:
+                # dead feed edge with no reassignment (the
+                # distrib_stall seeded bug): the subtree freezes and
+                # the staleness SLO catches it
+                return None
+            if prep["payload"] is None or now < prep["install_t"] + lat:
+                return None
+            return prep["version"], prep["payload"]
+        for version, payload in reversed(self._serve_committed):
+            if now >= self._serve_commit_t.get(version, 0.0) + lat:
+                return version, payload
+        return None
+
+    def _distrib_on_kill(self, i: int) -> None:
+        """A tree node died: its direct children re-parent via the
+        SAME greedy repair the real coordinator runs
+        (serve.distrib.tree.reassign — subtrees ride along), and tree
+        validity is re-audited.  The distrib_stall seeded bug skips
+        the repair, so the orphaned subtree freezes and the staleness
+        SLO fires instead."""
+        from bluefog_tpu.serve.distrib import tree as _dtree
+
+        if "distrib_stall" in self.cfg.debug_bugs:
+            return
+        old = dict(self._distrib_parents)
+        cap = "distrib_degree_overflow" not in self.cfg.debug_bugs
+        self._distrib_parents = _dtree.reassign(
+            old, i, self._distrib_fanout, degree_cap=cap)
+        self._distrib_lat.pop(i, None)
+        for c in sorted(self._distrib_parents):
+            if old.get(c) != self._distrib_parents[c]:
+                self._distrib_reparents += 1
+                self._distrib_lat[c] = self._distrib_edge_latency()
+                self._log("distrib_reparent", 1000 + c, dead=i,
+                          parent=self._distrib_parents[c])
+        self._distrib_check_tree(1000 + i)
+
+    def _distrib_place(self, i: int) -> None:
+        """Graft replica ``i`` into the tree as a leaf (a join-storm
+        arrival, or a respawned incarnation re-joining)."""
+        from bluefog_tpu.serve.distrib import tree as _dtree
+
+        cap = "distrib_degree_overflow" not in self.cfg.debug_bugs
+        p = _dtree.choose_parent(i, self._distrib_parents,
+                                 self._distrib_fanout,
+                                 dead=self._distrib_dead(),
+                                 degree_cap=cap)
+        self._distrib_parents[i] = p
+        self._distrib_lat[i] = self._distrib_edge_latency()
+        self._distrib_joins += 1
+        self._log("distrib_join", 1000 + i, parent=p)
+        self._distrib_check_tree(1000 + i)
+
+    def _distrib_join_storm_event(self, n: int):
+        def fire():
+            if self._all_done() or self.loop.now >= self.end_time:
+                return
+            base = max(self._serve_replicas, default=-1) + 1
+            for j in range(n):
+                i = base + j
+                self._serve_replicas[i] = {
+                    "version": 0, "payload": None, "swaps": 0,
+                    "steps": 0, "killed": False, "fired": False,
+                    "install_t": 0.0}
+                self._distrib_place(i)
+                off = ((1000 + i) * 37 % 101) / 101.0
+                self.loop.after(off * self.cfg.round_period,
+                                self._serve_replica_event(i))
+        return fire
 
     # -- adaptive demote/promote ------------------------------------------
 
@@ -1218,10 +1376,20 @@ class SimFleet:
         if self._serve_every > 0:
             # replicas outlive the training rounds: one final poll so
             # a replica whose cadence straddled the last publish still
-            # converges to the committed head before the audit
-            for i, rep in sorted(self._serve_replicas.items()):
-                if not rep["killed"]:
-                    self._serve_replica_step(i, rep)
+            # converges to the committed head before the audit (a
+            # tree-fed fleet needs one sweep per relay level — the
+            # head propagates one hop per poll)
+            from bluefog_tpu.serve.distrib import tree as _dtree
+            sweeps = 1 if self._distrib_fanout <= 0 else \
+                max(1, _dtree.tree_depth(self._distrib_parents) + 1)
+            # virtual time is frozen here, so in-flight edge latency
+            # would never elapse: the quiesce drain zeroes it (every
+            # real edge has long since delivered by end_time)
+            self._distrib_lat = {k: 0.0 for k in self._distrib_lat}
+            for _ in range(sweeps):
+                for i, rep in sorted(self._serve_replicas.items()):
+                    if not rep["killed"]:
+                        self._serve_replica_step(i, rep)
             out["serve"] = {
                 "published": self._serve_version,
                 "commits": len(self._serve_committed),
@@ -1230,6 +1398,15 @@ class SimFleet:
                         "swaps": rep["swaps"], "steps": rep["steps"],
                         "killed": rep["killed"]}
                     for i, rep in sorted(self._serve_replicas.items())}}
+            if self._distrib_fanout > 0:
+                out["serve"]["distrib"] = {
+                    "fanout": self._distrib_fanout,
+                    "parents": dict(sorted(
+                        self._distrib_parents.items())),
+                    "depth": _dtree.tree_depth(self._distrib_parents),
+                    "reparents": self._distrib_reparents,
+                    "joins": self._distrib_joins,
+                }
         return out
 
     def _members_now(self) -> Set[int]:

@@ -33,6 +33,8 @@ __all__ = [
     "check_partition_merge_mass",
     "check_serve_version_monotone",
     "check_serve_snapshot_committed",
+    "check_distrib_tree",
+    "check_distrib_staleness",
     "demotion_cap",
 ]
 
@@ -193,6 +195,33 @@ def check_serve_snapshot_committed(served: float,
     return (f"served payload {served!r} matches NO committed snapshot "
             f"(committed versions {vs[:8]}{'...' if len(vs) > 8 else ''})"
             " — a torn read mixed two buffer generations")
+
+
+def check_distrib_tree(parents: Dict[int, int],
+                       fanout: int) -> Optional[str]:
+    """The distribution fan-out tree must stay a tree: every replica
+    reaches the publisher (connected, acyclic) and no relay feeds more
+    than ``fanout`` children.  Delegates to the REAL repair code's
+    validator (:func:`bluefog_tpu.serve.distrib.tree.tree_valid`) so
+    the property audited in the sim and enforced by the coordinator is
+    literally the same function.  The publisher itself is uncapped —
+    it is the root of last resort when every relay is saturated."""
+    from bluefog_tpu.serve.distrib import tree as _tree
+
+    return _tree.tree_valid(dict(parents), int(fanout))
+
+
+def check_distrib_staleness(replica: int, lag: int,
+                            slo: int) -> Optional[str]:
+    """A tree-fed replica may trail the publisher's committed version
+    by at most ``slo`` versions (0 = unbounded).  A relay death whose
+    subtree never re-parents shows up here: the orphaned children stop
+    adopting new versions while the publisher keeps committing."""
+    if slo > 0 and lag > slo:
+        return (f"distrib replica {replica} is {lag} versions behind "
+                f"the publisher (staleness SLO {slo}) — its feed path "
+                "stalled (dead relay never re-parented?)")
+    return None
 
 
 def check_consensus(estimates: Dict[int, float], tol: float = 1e-6,

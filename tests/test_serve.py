@@ -314,8 +314,10 @@ def test_status_page_serve_plane_roundtrip(shm_dir):
         page.publish(nranks=0, step=3, epoch=1, op_id=2,
                      serve_version=7, serve_lag=2)
         got = sp.read_status_page(sp.status_page_path("sv5", 1000))
-        assert got["version"] == 5
+        assert got["version"] == sp.STATUS_VERSION
         assert got["serve"] == {"version": 7, "lag": 2}
+        # v6 default: not attached through the distribution tree
+        assert got["distrib"] == {"slot": -1, "parent": -1}
         # default: not part of the serve plane
         page.publish(nranks=4, step=4, epoch=1, op_id=3)
         got = sp.read_status_page(sp.status_page_path("sv5", 1000))
