@@ -12,6 +12,9 @@
 #   make distrib         distribution-plane gate: the distrib rule family
 #                        (pinned tree campaigns + kill/delta models) plus the
 #                        loopback fan-out bench arm (benchmarks/serving.py)
+#   make loadgen         serve-traffic gate: the slo rule family (pinned
+#                        Poisson campaigns + latency-sampler pins) plus the
+#                        open-loop load bench arm (benchmarks/serving.py load)
 #
 # All targets force the CPU backend so they run on any host.
 
@@ -20,7 +23,7 @@ ENV     := JAX_PLATFORMS=cpu
 PYTEST  := $(ENV) $(PY) -m pytest tests/ -q -m 'not slow' \
            --continue-on-collection-errors -p no:cacheprovider
 
-.PHONY: verify analyze selftest changed test distrib
+.PHONY: verify analyze selftest changed test distrib loadgen
 
 verify: selftest analyze test
 
@@ -40,3 +43,7 @@ test:
 distrib:
 	$(ENV) $(PY) -m bluefog_tpu.analysis --family distrib
 	$(ENV) $(PY) benchmarks/serving.py distrib
+
+loadgen:
+	$(ENV) $(PY) -m bluefog_tpu.analysis --family slo
+	$(ENV) $(PY) benchmarks/serving.py load

@@ -588,6 +588,20 @@ def main():
             print(json.dumps(srate), file=sys.stderr)
         except Exception as e:  # noqa: BLE001
             print(f"serving phase failed: {e!r}", file=sys.stderr)
+    lod = None
+    if time.perf_counter() - t_start < budget_s:
+        try:
+            # serve traffic observatory (docs/SERVING.md "Measuring
+            # serve latency under churn"): open-loop Poisson load at
+            # K in-process replicas, idle vs a 1.5 s publish cadence
+            # with hot-swaps between requests; latency charged from
+            # the SCHEDULED send, so swap stalls surface as queueing
+            # delay instead of vanishing (coordinated omission)
+            from serving import measure_load
+            lod = measure_load()
+            print(json.dumps(lod), file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"serve load phase failed: {e!r}", file=sys.stderr)
     dst = None
     if time.perf_counter() - t_start < budget_s:
         try:
@@ -749,6 +763,15 @@ def main():
     if srate is not None:
         headline["serve_rate_steps_s"] = srate["value"]
         headline["serve_rate_metric"] = srate["metric"]
+    if lod is not None:
+        # per-fleet dicts keyed by replica count ("4"/"8"): the gate
+        # is that the churn p99 stays FINITE at every fleet size (no
+        # dropped or errored requests hiding in the tail)
+        headline["serve_p99_idle_ms"] = lod["p99_idle_by_fleet_ms"]
+        headline["serve_p99_during_publish_ms"] = \
+            lod["p99_publish_by_fleet_ms"]
+        headline["serve_qps_sustained"] = lod["qps_by_fleet"]
+        headline["serve_load_metric"] = lod["metric"]
     if dst is not None:
         headline["distrib_all_swap_ms"] = dst["value"]
         headline["distrib_metric"] = dst["metric"]

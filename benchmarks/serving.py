@@ -139,6 +139,115 @@ def measure_serve_rate(steps: int = 20000, payload_kb: int = 64) -> dict:
     }
 
 
+def measure_load(replica_counts=(4, 8), rate_hz: float = 200.0,
+                 idle_s: float = 1.2, publish_period_s: float = 1.5,
+                 publishes: int = 2, payload_kb: int = 64) -> dict:
+    """Open-loop load arm (docs/SERVING.md "Measuring serve latency
+    under churn"): Poisson arrivals at ``rate_hz`` per replica against
+    K in-process replicas, once idle and once while the publisher
+    commits on a ``publish_period_s`` cadence with a poller hot-swapping
+    every replica between requests.
+
+    Latency is charged from the SCHEDULED send instant
+    (:mod:`bluefog_tpu.serve.loadgen`), so a swap stall shows up as
+    queueing delay on every overdue request instead of silently
+    vanishing (coordinated omission).  ``value`` is the churn-phase
+    p99 at the largest fleet (bench.py's
+    ``serve_p99_during_publish_ms`` rides the per-fleet dict).
+    """
+    import threading
+
+    from bluefog_tpu.native import shm_native
+    from bluefog_tpu.serve import LoadGenerator, Replica
+    from bluefog_tpu.serve.snapshot import SnapshotRegion
+
+    job = f"svl{os.getpid()}"
+    payload = np.ones(payload_kb * 1024 // 8, np.float64)
+    p99_idle, p99_pub, qps, p50_idle, p50_pub = {}, {}, {}, {}, {}
+    region = SnapshotRegion(job, payload.nbytes)
+    version = 0
+    try:
+        for k in replica_counts:
+            version += 1
+            payload.fill(float(version))
+            region.publish(payload, epoch=version, step=version)
+            reps = [Replica(job, i, publish_page=False)
+                    for i in range(k)]
+            try:
+                for r in reps:
+                    r.poll_swap()
+                    assert r.version, "bootstrap install failed"
+                idle = LoadGenerator(reps, rate_hz=rate_hz,
+                                     schedule="poisson",
+                                     duration_s=idle_s, seed=7).run()
+                stop = threading.Event()
+
+                def _publisher():
+                    nonlocal version
+                    for _ in range(publishes):
+                        if stop.wait(publish_period_s):
+                            return
+                        version += 1
+                        payload.fill(float(version))
+                        region.publish(payload, epoch=version,
+                                       step=version)
+
+                def _poller():
+                    while not stop.is_set():
+                        for r in reps:
+                            r.poll_swap()
+                        time.sleep(0.001)
+
+                churn_s = publishes * publish_period_s + 0.5
+                gen = LoadGenerator(reps, rate_hz=rate_hz,
+                                    schedule="poisson",
+                                    duration_s=churn_s, seed=11)
+                aux = [threading.Thread(target=t, daemon=True)
+                       for t in (_publisher, _poller)]
+                for t in aux:
+                    t.start()
+                churn = gen.run()
+                stop.set()
+                for t in aux:
+                    t.join(timeout=10)
+                # every request answered, none errored: the churn
+                # phase would falsify zero-downtime with a single
+                # failed serve_step, not just slow the tail down
+                assert idle.requests and churn.requests, (k, idle, churn)
+                bad = {o: n for o, n in churn.outcomes.items()
+                       if o != "ok"}
+                assert not bad, (k, bad)
+                kk = str(k)
+                p50_idle[kk] = round(idle.p50_ms, 3)
+                p99_idle[kk] = round(idle.p99_ms, 3)
+                p50_pub[kk] = round(churn.p50_ms, 3)
+                p99_pub[kk] = round(churn.p99_ms, 3)
+                qps[kk] = round(churn.qps, 1)
+            finally:
+                for r in reps:
+                    r.close()
+    finally:
+        region.close()
+        shm_native.unlink_all(job)
+    top = str(replica_counts[-1])
+    return {
+        "metric": f"open-loop serve p99 under publish churn "
+                  f"({payload_kb} KB snapshot, poisson "
+                  f"{rate_hz:g} Hz/replica, {publish_period_s:g} s "
+                  f"publish cadence, at {top} replicas)",
+        "value": p99_pub[top],
+        "unit": "ms",
+        "rate_hz": rate_hz,
+        "publish_period_s": publish_period_s,
+        "replica_counts": list(replica_counts),
+        "p50_idle_by_fleet_ms": p50_idle,
+        "p99_idle_by_fleet_ms": p99_idle,
+        "p50_publish_by_fleet_ms": p50_pub,
+        "p99_publish_by_fleet_ms": p99_pub,
+        "qps_by_fleet": qps,
+    }
+
+
 def measure_distrib(replicas=(4, 8, 16), versions: int = 8,
                     payload_kb: int = 1024) -> dict:
     """Distribution-plane arm (docs/SERVING.md "Cross-host
@@ -281,7 +390,10 @@ if __name__ == "__main__":
 
     if "distrib" in sys.argv[1:]:
         print(json.dumps({"distrib": measure_distrib()}))
+    elif "load" in sys.argv[1:]:
+        print(json.dumps({"load": measure_load()}))
     else:
         print(json.dumps({"publish_swap": measure_publish_swap(),
                           "serve_rate": measure_serve_rate(),
-                          "distrib": measure_distrib()}))
+                          "distrib": measure_distrib(),
+                          "load": measure_load()}))

@@ -74,6 +74,13 @@ consumers (CLI, pytest, CI):
   and pinned distribution campaigns (interior relay killed mid-fan-out,
   join storm mid-rollout) keep the tree-validity and staleness-SLO
   standing invariants silent while subtrees re-parent and converge;
+- **slo** (:mod:`.slo_rules`) — the serve traffic observatory: pinned
+  Poisson-load campaigns serve every admitted request within the SLO
+  or excuse it with an overlapping fault window (replica kill,
+  publisher death, publish churn), the seeded drain-skip and
+  send-re-anchor bugs are caught by the request-SLO and open-loop
+  invariants, and the trace-fitted per-edge latency sampler honors
+  its measured anchors deterministically;
 - **lab** (:mod:`.lab_rules`) — the convergence observatory's frozen
   sweep artifact: schema-valid, cell fits refittable from their own
   series, scaling laws non-increasing in fleet size, measured rates
@@ -136,6 +143,7 @@ from bluefog_tpu.analysis import (  # noqa: F401
     seqlock_model,
     serve_rules,
     sim_rules,
+    slo_rules,
     telemetry_rules,
     trace_rules,
     transport_spec,

@@ -96,6 +96,8 @@ def main(argv=None) -> int:
             "introspect": "~2 s", "sim": "~10 s (pinned fault campaigns)",
             "partition": "~10 s (pinned partition campaigns)",
             "serve": "~10 s (pinned serve campaigns + buffer model)",
+            "slo": "~10 s (pinned traffic campaigns + latency "
+                   "sampler pins)",
             "distrib": "~15 s (pinned tree campaigns + exhaustive "
                        "kill/delta models)",
             "lab": "~5 s (frozen sweep artifact re-derivation)",
@@ -189,6 +191,29 @@ def main(argv=None) -> int:
         if stale:
             print(f"self-test FAILED: serve campaign(s) failed {stale}")
             return 1
+        # slo arm: Poisson load over >= 64 virtual replicas under
+        # relay kills and publish churn — zero unattributed request
+        # violations, nonzero excused traffic, bit-identical replays
+        from bluefog_tpu.analysis import slo_rules
+
+        unattributed = []
+        for label, res, findings in (
+                slo_rules.selftest_slo_campaigns()):
+            ok = not findings
+            arr = res.final.get("arrivals") or {}
+            print(f"  {label:<36s} "
+                  f"{'clean' if ok else 'VIOLATED'} "
+                  f"(served={arr.get('served')}, "
+                  f"attributed={arr.get('attributed')}, "
+                  f"digest={res.digest[:12]})")
+            for f in findings:
+                print(f"    {f}")
+            if not ok:
+                unattributed.append(label)
+        if unattributed:
+            print(f"self-test FAILED: traffic campaign(s) failed "
+                  f"{unattributed}")
+            return 1
         # distrib arm: acceptance-size distribution-tree campaigns
         # (relay kills + join storm mid-rollout at >= 64 ranks) must
         # re-parent cleanly, converge, and replay bit-identically
@@ -266,6 +291,7 @@ def main(argv=None) -> int:
               f"caught, {len(sim_rules.SELFTEST_PINS)} pinned campaigns "
               f"+ {len(partition_rules.PARTITION_PINS)} partition "
               f"+ {len(serve_rules.SERVE_PINS)} serve "
+              f"+ {len(slo_rules.SLO_PINS)} traffic "
               f"+ {len(distrib_rules.DISTRIB_PINS)} distrib campaigns "
               f"clean, "
               f"lab artifact verified ({ncells} cells), transports "

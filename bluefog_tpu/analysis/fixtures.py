@@ -766,6 +766,32 @@ def _conformance_epoch_reseed_skipped() -> List[Finding]:
     return conformance.mutant_reseed_findings()
 
 
+def _slo_silent_violation() -> List[Finding]:
+    """A traffic campaign whose replica drain only runs every third
+    poll (``slo_silent_violation``): requests queue past the latency
+    SLO with no fault window to blame, and the request-SLO standing
+    invariant must flag the silent stall."""
+    from bluefog_tpu.analysis import sim_rules, slo_rules
+
+    _cfg, _sched, res = slo_rules.slo_campaign(
+        16, 24, 3, debug_bugs=("slo_silent_violation",))
+    return sim_rules.campaign_findings(
+        res, "fixture[slo-silent-violation]")
+
+
+def _omission_biased_loadgen() -> List[Finding]:
+    """A traffic campaign whose drain re-anchors each request's send
+    time to the drain instant (``loadgen_omission``): queueing delay
+    vanishes from the measurement — coordinated omission — and the
+    open-loop standing invariant must flag it."""
+    from bluefog_tpu.analysis import sim_rules, slo_rules
+
+    _cfg, _sched, res = slo_rules.slo_campaign(
+        16, 24, 3, debug_bugs=("loadgen_omission",))
+    return sim_rules.campaign_findings(
+        res, "fixture[omission-biased-loadgen]")
+
+
 FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
     # plan family
     "plan-duplicate-destination": _plan_duplicate_destination,
@@ -846,6 +872,10 @@ FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
     "serve-version-reset": _serve_version_reset,
     "serve-torn-swap": _serve_torn_swap,
     "serve-torn-read-model": _serve_torn_read_model,
+    # slo family: a drain that skips polls (silent SLO hole) and a
+    # drain that re-anchors send times (coordinated omission)
+    "slo-silent-violation": _slo_silent_violation,
+    "omission-biased-loadgen": _omission_biased_loadgen,
     # distrib family: an uncapped tree repair, a stalled orphan
     # subtree, a regressing publisher handoff, a dirty chunk dropped
     # from a delta

@@ -92,7 +92,8 @@ def render(snap: dict, rates: Dict[int, float]) -> str:
     lines.append("")
     lines.append(f"{'RANK':>4} {'STEP':>8} {'STEP/S':>7} {'EPOCH':>5} "
                  f"{'LAST OP':<12} {'BALANCE':>10} {'CONV':>9} "
-                 f"{'SERVE':>9} {'QUEUE':<14} {'HOLDS':<8} EDGES")
+                 f"{'SERVE':>9} {'QPS':>7} {'P99MS':>7} {'SLO':>4} "
+                 f"{'QUEUE':<14} {'HOLDS':<8} EDGES")
     for r in ranks:
         page = snap["ranks"][str(r)]
         if "error" in page:
@@ -129,6 +130,13 @@ def render(snap: dict, rates: Dict[int, float]) -> str:
             par = dv.get("parent", -1)
             serve_s += f" s{dv['slot']}<" + (
                 "P" if par < 0 else str(par))
+        # request plane (statuspage v7): rolling-window QPS + p99 and
+        # the SLO lamp (— = no SLO armed / no traffic, ok = inside the
+        # objective, VIOL = in an open violation window)
+        qps, p99 = sv.get("qps", -1.0), sv.get("p99_ms", -1.0)
+        qps_s = f"{qps:.1f}" if qps >= 0 else "—"
+        p99_s = f"{p99:.2f}" if p99 >= 0 else "—"
+        slo_s = {0: "ok", 1: "VIOL"}.get(sv.get("slo_state", -1), "—")
         # an ORPHAN rank quiesced on quorum loss — the page freezes at
         # the denial, so the state outranks whatever op came last
         last_op = "ORPHAN" if page.get("orphan") else page["last_op"]
@@ -137,7 +145,8 @@ def render(snap: dict, rates: Dict[int, float]) -> str:
             f"{('%.1f' % rate) if rate is not None else '—':>7} "
             f"{page['epoch']:>5} {last_op:<12} "
             f"{page['ledger']['balance']:>10.3g} {conv_s:>9} "
-            f"{serve_s:>9} {queue:<14} {holds:<8} {edges}")
+            f"{serve_s:>9} {qps_s:>7} {p99_s:>7} {slo_s:>4} "
+            f"{queue:<14} {holds:<8} {edges}")
     if snap.get("serve"):
         lines.append("")
         # tree replicas append "slot<parent" ("<P" = publisher-fed),
@@ -148,7 +157,9 @@ def render(snap: dict, rates: Dict[int, float]) -> str:
                 f"r{r} v{v['version']} lag {max(0, v['lag'])}" + (
                     f" s{v['slot']}<" + ("P" if v.get("parent", -1) < 0
                                          else str(v["parent"]))
-                    if v.get("slot", -1) >= 0 else "")
+                    if v.get("slot", -1) >= 0 else "") + (
+                    f" {v['qps']:.0f}/s p99 {v['p99_ms']:.1f}ms"
+                    if v.get("qps", -1.0) >= 0 else "")
                 for r, v in sorted(snap["serve"].items(),
                                    key=lambda kv: int(kv[0]))))
     if snap.get("orphans"):

@@ -29,9 +29,9 @@ from typing import Dict, List, Optional, Tuple
 
 from bluefog_tpu.native import shm_native
 
-STATUS_SCHEMA = "bftpu-statuspage/6"
+STATUS_SCHEMA = "bftpu-statuspage/7"
 STATUS_MAGIC = 0x42465350  # "BFSP"
-STATUS_VERSION = 6
+STATUS_VERSION = 7
 
 #: Page layout: header (magic u32, version u32, seq u64), fixed block,
 #: then up to MAX_EDGES edge records; the whole page is padded to
@@ -48,8 +48,13 @@ STATUS_VERSION = 6
 #: (distrib_slot + distrib_parent — this replica's slot in the fan-out
 #: tree and the slot it feeds from, -1 parent = the publisher itself;
 #: slot -1 = not attached through the distribution plane, see
-#: docs/SERVING.md "Cross-host distribution").  Readers still decode
-#: v1..v5 pages from live older writers.
+#: docs/SERVING.md "Cross-host distribution"); v7 appends the
+#: request-level serve telemetry (qps + p50_ms + p99_ms over the
+#: replica's rolling request window, and slo_state: -1 = no SLO armed
+#: or no traffic yet, 0 = inside the BFTPU_SERVE_SLO_MS objective,
+#: 1 = currently violating — see docs/SERVING.md "Measuring serve
+#: latency under churn").  Readers still decode v1..v6 pages from live
+#: older writers.
 _HEAD = struct.Struct("<IIQ")                 # magic, version, seq
 _FIXED_V1 = struct.Struct("<iiiiQQQdd16sdddd")  # rank, nranks, pid, n_edges,
 #                                                 step, epoch, op_id,
@@ -61,8 +66,11 @@ _FIXED_V3 = struct.Struct("<iiiiQQQdd16sddddi16sdq")  # ... + conv_err,
 _FIXED_V4 = struct.Struct("<iiiiQQQdd16sddddi16sdqi")  # ... + flags
 _FIXED_V5 = struct.Struct("<iiiiQQQdd16sddddi16sdqiqq")  # ... +
 #                                               serve_version, serve_lag
-_FIXED = struct.Struct("<iiiiQQQdd16sddddi16sdqiqqii")   # ... +
+_FIXED_V6 = struct.Struct("<iiiiQQQdd16sddddi16sdqiqqii")  # ... +
 #                                               distrib_slot, distrib_parent
+_FIXED = struct.Struct("<iiiiQQQdd16sddddi16sdqiqqiidddi")  # ... +
+#                                               qps, p50_ms, p99_ms,
+#                                               slo_state
 _EDGE = struct.Struct("<iid")                 # peer_global, state, deadline_s
 MAX_EDGES = 32
 PAGE_BYTES = 1024
@@ -108,7 +116,9 @@ class StatusPage:
                 conv_err: float = -1.0, conv_round: int = -1,
                 flags: int = 0, serve_version: int = -1,
                 serve_lag: int = -1, distrib_slot: int = -1,
-                distrib_parent: int = -1) -> None:
+                distrib_parent: int = -1, qps: float = -1.0,
+                p50_ms: float = -1.0, p99_ms: float = -1.0,
+                slo_state: int = -1) -> None:
         """Seqlocked single-writer update of the whole page.
 
         ``edges`` is an iterable of ``(peer_global, state_code,
@@ -122,7 +132,11 @@ class StatusPage:
         (-1 = this rank neither publishes nor serves snapshots);
         ``distrib_slot``/``distrib_parent`` are the v6 distribution
         tree (slot -1 = not attached through the distribution plane,
-        parent -1 = fed straight by the publisher)."""
+        parent -1 = fed straight by the publisher);
+        ``qps``/``p50_ms``/``p99_ms``/``slo_state`` are the v7
+        request-level serve telemetry (-1 = no request traffic
+        observed; slo_state 0 = within the latency SLO, 1 =
+        violating)."""
         mm = self._seg._mm
         led = ledger or {}
         ed = list(edges)[:MAX_EDGES]
@@ -142,7 +156,8 @@ class StatusPage:
             str(inflight).encode("utf-8", "replace")[:16],
             float(conv_err), int(conv_round), int(flags),
             int(serve_version), int(serve_lag),
-            int(distrib_slot), int(distrib_parent))
+            int(distrib_slot), int(distrib_parent),
+            float(qps), float(p50_ms), float(p99_ms), int(slo_state))
         off = _HEAD.size + _FIXED.size
         for peer, state, deadline in ed:
             _EDGE.pack_into(mm, off, int(peer), int(state), float(deadline))
@@ -158,7 +173,7 @@ def _decode(buf: bytes) -> Dict[str, object]:
     magic, version, seq = _HEAD.unpack_from(buf, 0)
     if magic != STATUS_MAGIC:
         raise ValueError(f"not a status page (magic 0x{magic:08x})")
-    if version not in (1, 2, 3, 4, 5, STATUS_VERSION):
+    if version not in (1, 2, 3, 4, 5, 6, STATUS_VERSION):
         raise ValueError(f"unsupported status-page version {version}")
     if version == 1:
         # a live v1 writer (mid-upgrade fleet): no progress-engine block
@@ -170,6 +185,7 @@ def _decode(buf: bytes) -> Dict[str, object]:
         flags = 0
         serve_version, serve_lag = -1, -1
         distrib_slot, distrib_parent = -1, -1
+        qps, p50_ms, p99_ms, slo_state = -1.0, -1.0, -1.0, -1
         fixed_size = _FIXED_V1.size
     elif version == 2:
         # a live v2 writer: progress block, no convergence word
@@ -180,6 +196,7 @@ def _decode(buf: bytes) -> Dict[str, object]:
         flags = 0
         serve_version, serve_lag = -1, -1
         distrib_slot, distrib_parent = -1, -1
+        qps, p50_ms, p99_ms, slo_state = -1.0, -1.0, -1.0, -1
         fixed_size = _FIXED_V2.size
     elif version == 3:
         # a live v3 writer: convergence word, no flags word
@@ -189,6 +206,7 @@ def _decode(buf: bytes) -> Dict[str, object]:
         flags = 0
         serve_version, serve_lag = -1, -1
         distrib_slot, distrib_parent = -1, -1
+        qps, p50_ms, p99_ms, slo_state = -1.0, -1.0, -1.0, -1
         fixed_size = _FIXED_V3.size
     elif version == 4:
         # a live v4 writer: flags word, no serving plane
@@ -198,6 +216,7 @@ def _decode(buf: bytes) -> Dict[str, object]:
             buf, _HEAD.size)
         serve_version, serve_lag = -1, -1
         distrib_slot, distrib_parent = -1, -1
+        qps, p50_ms, p99_ms, slo_state = -1.0, -1.0, -1.0, -1
         fixed_size = _FIXED_V4.size
     elif version == 5:
         # a live v5 writer: serving plane, no distribution tree
@@ -207,13 +226,25 @@ def _decode(buf: bytes) -> Dict[str, object]:
          serve_version, serve_lag) = _FIXED_V5.unpack_from(
             buf, _HEAD.size)
         distrib_slot, distrib_parent = -1, -1
+        qps, p50_ms, p99_ms, slo_state = -1.0, -1.0, -1.0, -1
         fixed_size = _FIXED_V5.size
+    elif version == 6:
+        # a live v6 writer: distribution tree, no request telemetry
+        (rank, nranks, pid, n_edges, step, epoch, op_id, wall_ts, mono_ts,
+         last_op, dep, col, drn, pend, qdepth, inflight,
+         conv_err, conv_round, flags,
+         serve_version, serve_lag,
+         distrib_slot, distrib_parent) = _FIXED_V6.unpack_from(
+            buf, _HEAD.size)
+        qps, p50_ms, p99_ms, slo_state = -1.0, -1.0, -1.0, -1
+        fixed_size = _FIXED_V6.size
     else:
         (rank, nranks, pid, n_edges, step, epoch, op_id, wall_ts, mono_ts,
          last_op, dep, col, drn, pend, qdepth, inflight,
          conv_err, conv_round, flags,
          serve_version, serve_lag,
-         distrib_slot, distrib_parent) = _FIXED.unpack_from(
+         distrib_slot, distrib_parent,
+         qps, p50_ms, p99_ms, slo_state) = _FIXED.unpack_from(
             buf, _HEAD.size)
         fixed_size = _FIXED.size
     edges: List[Dict[str, object]] = []
@@ -268,6 +299,15 @@ def _decode(buf: bytes) -> Dict[str, object]:
         "serve": {
             "version": int(serve_version),
             "lag": int(serve_lag),
+            # v7 request telemetry over the replica's rolling window:
+            # qps/p50/p99 read -1.0 while no request traffic has been
+            # observed; slo_state -1 = no SLO armed (or no traffic),
+            # 0 = within BFTPU_SERVE_SLO_MS, 1 = currently violating.
+            # Non-finite values sanitized so collect() stays strict-JSON.
+            "qps": float(qps) if math.isfinite(qps) else -1.0,
+            "p50_ms": float(p50_ms) if math.isfinite(p50_ms) else -1.0,
+            "p99_ms": float(p99_ms) if math.isfinite(p99_ms) else -1.0,
+            "slo_state": int(slo_state),
         },
         # the distribution tree (docs/SERVING.md "Cross-host
         # distribution"): slot -1 = not attached through the distrib

@@ -124,6 +124,14 @@ _DISTRIB_KEYS = ("BFTPU_DISTRIB_FANOUT", "BFTPU_DISTRIB_HORIZON",
                  "BFTPU_DISTRIB_CHUNK_KB", "BFTPU_DISTRIB_TIMEOUT_S",
                  "BFTPU_DISTRIB_RETRIES")
 
+# load-generator + serve-SLO knobs (bluefog_tpu.serve.loadgen): a
+# stale rate or schedule changes the next test's offered load, and a
+# stale SLO objective arms violation windows the next fleet never
+# asked for — schedule-grade state like everything above
+_LOADGEN_KEYS = ("BFTPU_LOADGEN_RATE_HZ", "BFTPU_LOADGEN_SCHEDULE",
+                 "BFTPU_LOADGEN_SEED", "BFTPU_LOADGEN_DURATION_S",
+                 "BFTPU_SERVE_SLO_MS", "BFTPU_SERVE_SLO_STALENESS")
+
 # injectable clock (sim/clock.py seam) for the delay/straggler sleeps;
 # process-level signals (suspend_self) always use wall time — you
 # cannot virtualize a SIGSTOP
@@ -332,7 +340,7 @@ def clear_schedule() -> None:
     the fault in the next test's workers) — plus the sim-campaign,
     lab, and serving-plane keys, which are schedules by another name."""
     for k in _ALL_KEYS + _SIM_KEYS + _LAB_KEYS + _SERVE_KEYS \
-            + _DISTRIB_KEYS:
+            + _DISTRIB_KEYS + _LOADGEN_KEYS:
         os.environ.pop(k, None)
 
 

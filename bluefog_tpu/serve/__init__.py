@@ -14,6 +14,10 @@ downtime.
 - :mod:`bluefog_tpu.serve.replica` — the subscriber: atomic-flip
   hot-swap, bounded full-jitter retry, and the
   ``BFTPU_SERVE_MAX_LAG`` staleness policy.
+- :mod:`bluefog_tpu.serve.loadgen` — the open-loop load generator
+  (Poisson / fixed-rate arrivals, coordinated-omission-safe latency)
+  and the ``BFTPU_SERVE_SLO_MS`` / ``BFTPU_SERVE_SLO_STALENESS``
+  violation-window monitor.
 - ``python -m bluefog_tpu.serve`` — one replica process (what
   ``bftpu-run --serve-replicas K`` spawns K of).
 
@@ -29,6 +33,13 @@ from bluefog_tpu.serve.replica import (
     full_jitter,
     serve_max_lag,
     serve_stale_policy,
+)
+from bluefog_tpu.serve.loadgen import (
+    LoadGenerator,
+    LoadReport,
+    SLOMonitor,
+    serve_slo_ms,
+    serve_slo_staleness,
 )
 from bluefog_tpu.serve.snapshot import (
     SERVE_SCHEMA,
@@ -53,4 +64,9 @@ __all__ = [
     "full_jitter",
     "serve_max_lag",
     "serve_stale_policy",
+    "LoadGenerator",
+    "LoadReport",
+    "SLOMonitor",
+    "serve_slo_ms",
+    "serve_slo_staleness",
 ]

@@ -29,6 +29,7 @@ from __future__ import annotations
 import os
 import random
 import time
+from collections import deque
 from typing import Optional, Tuple
 
 import numpy as np
@@ -153,6 +154,45 @@ def _transient_errors() -> tuple:
     return tuple(errs)
 
 
+class _RequestStats:
+    """Rolling-window request latencies for the statuspage.
+
+    Keeps the last ``window_s`` of (done_mono, latency_ms) completions;
+    the page publishes window QPS and p50/p99 so ``bftpu-top`` shows
+    *current* traffic, not lifetime averages that smear a stall."""
+
+    __slots__ = ("window_s", "_buf")
+
+    def __init__(self, window_s: float = 5.0):
+        self.window_s = float(window_s)
+        self._buf: deque = deque()
+
+    def note(self, done_mono: float, latency_ms: float) -> None:
+        self._buf.append((float(done_mono), float(latency_ms)))
+        cut = done_mono - self.window_s
+        while self._buf and self._buf[0][0] < cut:
+            self._buf.popleft()
+
+    def snapshot(self, now: float) -> Tuple[float, float, float]:
+        """(qps, p50_ms, p99_ms) over the window; (-1,-1,-1) when empty."""
+        cut = now - self.window_s
+        while self._buf and self._buf[0][0] < cut:
+            self._buf.popleft()
+        if not self._buf:
+            return -1.0, -1.0, -1.0
+        lat = sorted(l for _, l in self._buf)
+        span = max(0.05, min(self.window_s, now - self._buf[0][0]))
+        n = len(lat)
+
+        def q(p: float) -> float:
+            pos = p * (n - 1)
+            lo = int(pos)
+            hi = min(lo + 1, n - 1)
+            return lat[lo] + (lat[hi] - lat[lo]) * (pos - lo)
+
+        return n / span, q(0.50), q(0.99)
+
+
 class Replica:
     """One serving process: poll → side-read → atomic flip → serve."""
 
@@ -172,6 +212,9 @@ class Replica:
         self.serve_steps = 0
         self.stale_served = 0
         self.retries = 0
+        self._req_stats: Optional[_RequestStats] = None
+        self._slo = None  # lazy loadgen.slo.SLOMonitor
+        self._page_throttle_t = 0.0
         self._page = None
         if publish_page:
             from bluefog_tpu.introspect.statuspage import StatusPage
@@ -198,12 +241,17 @@ class Replica:
         # the tree (slot -1 = shm-attached, not in the tree)
         slot = getattr(self.source, "slot", None)
         parent = getattr(self.source, "parent_slot", -1)
+        qps = p50 = p99 = -1.0
+        if self._req_stats is not None:
+            qps, p50, p99 = self._req_stats.snapshot(time.monotonic())
         self._page.publish(
             nranks=0, step=self.serve_steps,
             epoch=cur[1] if cur else 0, op_id=self.swaps,
             last_op=op, serve_version=self.version, serve_lag=self.lag,
             distrib_slot=-1 if slot is None else int(slot),
-            distrib_parent=int(parent))
+            distrib_parent=int(parent),
+            qps=qps, p50_ms=p50, p99_ms=p99,
+            slo_state=self._slo.state if self._slo is not None else -1)
 
     # -- subscribe / swap --------------------------------------------------
 
@@ -307,7 +355,67 @@ class Replica:
             return version, arr
         return version, arr.reshape(-1) @ np.asarray(x).reshape(-1)
 
+    # -- request-level telemetry -------------------------------------------
+
+    def note_request(self, send_mono: float, done_mono: float, *,
+                     version: int = 0, outcome: str = "ok",
+                     start_mono: Optional[float] = None) -> bool:
+        """Record one completed request (open-loop latency basis).
+
+        ``send_mono`` is the *scheduled* send time, so the latency
+        charged here includes any queueing the request suffered before
+        ``serve_step`` ran (the loadgen's coordinated-omission fix).
+        Feeds the ``serve.request_latency`` histogram, the per-replica
+        SLO monitor, and — throttled to ~4 Hz — the statuspage QPS /
+        p50 / p99 / SLO columns.  Returns True iff the request violated
+        an armed SLO."""
+        from bluefog_tpu.serve.loadgen.slo import SLOMonitor
+        if self._req_stats is None:
+            self._req_stats = _RequestStats()
+        if self._slo is None:
+            self._slo = SLOMonitor(self.replica_id)
+        latency_ms = max(0.0, (float(done_mono) - float(send_mono)) * 1e3)
+        self._req_stats.note(done_mono, latency_ms)
+        violated = self._slo.note(send_mono, done_mono, lag=self.lag)
+        reg = _telemetry.get_registry()
+        if reg.enabled:
+            rid = str(self.replica_id)
+            reg.counter("serve.requests", replica=rid,
+                        outcome=str(outcome)).inc()
+            reg.histogram(
+                "serve.request_latency",
+                buckets=_telemetry.SERVE_LATENCY_BUCKETS_S,
+                replica=rid).observe(latency_ms / 1e3)
+            if violated:
+                reg.counter("serve.slo_violations", replica=rid).inc()
+            reg.journal(
+                "serve_request", replica=self.replica_id,
+                send_mono=float(send_mono),
+                start_mono=float(send_mono if start_mono is None
+                                 else start_mono),
+                done_mono=float(done_mono), latency_ms=latency_ms,
+                version=int(version), lag=self.lag, outcome=str(outcome))
+        now = time.monotonic()
+        if now - self._page_throttle_t >= 0.25:
+            self._page_throttle_t = now
+            if reg.enabled:
+                qps, _, _ = self._req_stats.snapshot(now)
+                if qps >= 0:
+                    reg.gauge("serve.qps", replica=str(self.replica_id)
+                              ).set(qps)
+            self._publish_page("serve")
+        return violated
+
+    def close_slo(self) -> None:
+        """Flush the SLO monitor's open violation window (teardown)."""
+        if self._slo is not None:
+            self._slo.close()
+            self._publish_page("slo-flush")
+
     def close(self, unlink: bool = False) -> None:
+        if self._slo is not None:
+            self._slo.close()
+            self._slo = None
         if self._page is not None:
             self._page.close(unlink)
             self._page = None

@@ -113,6 +113,36 @@ def build_parser() -> argparse.ArgumentParser:
                     help="seed an intentional bug (mass_leak, "
                          "cap_bypass, split_brain) — the campaign "
                          "should CATCH it")
+    ap.add_argument("--serve-every", type=int, default=0,
+                    metavar="N",
+                    help="arm the serving plane: the publisher commits "
+                         "a snapshot every N rounds (0 = off)")
+    ap.add_argument("--serve-replicas", type=int, default=0,
+                    metavar="K",
+                    help="hot-swap replica models polling the "
+                         "committed head (0 = off)")
+    ap.add_argument("--arrivals", choices=("poisson", "fixed"),
+                    default="",
+                    help="replay an open-loop request process against "
+                         "the serving replicas (needs --serve-every "
+                         "and --serve-replicas); arms the request-SLO "
+                         "and staleness-SLO standing invariants")
+    ap.add_argument("--arrival-rate", type=float, default=2.0,
+                    metavar="HZ",
+                    help="requests per virtual second per replica")
+    ap.add_argument("--request-slo-ms", type=float, default=0.0,
+                    metavar="MS",
+                    help="per-request latency SLO on the virtual "
+                         "clock (0 = 2x the round period)")
+    ap.add_argument("--request-staleness-slo", type=int, default=0,
+                    metavar="V",
+                    help="max versions behind the committed head a "
+                         "served request may be (0 = unbounded)")
+    ap.add_argument("--latency-from-trace", metavar="FILE",
+                    help="fit the per-edge gossip latency to a merged "
+                         "trace's critical-path report (replaces the "
+                         "uniform --latency-ms draw with empirical "
+                         "per-edge quantile samplers)")
     ap.add_argument("--quorum", choices=("majority", "off"),
                     default=str(_env("BFTPU_SIM_QUORUM", "majority")),
                     help="membership-commit quorum fence (mirrors "
@@ -131,6 +161,12 @@ def _print(summary: dict, as_json: bool, violations: List[dict]) -> None:
           f"digest={summary['digest']} members={summary['members']} "
           f"events={summary['events']} faults={summary['faults']} "
           f"spread={summary['estimate_spread']:.3e}")
+    arr = summary.get("arrivals")
+    if arr:
+        print(f"bftpu-sim: arrivals {arr['process']}@{arr['rate']:g}/s "
+              f"admitted={arr['admitted']} served={arr['served']} "
+              f"attributed={arr['attributed']} "
+              f"violations={arr['violations']}")
     led = summary.get("ledger") or {}
     print(f"bftpu-sim: ledger deposits={led.get('deposits')} "
           f"collected={led.get('collected')} "
@@ -164,6 +200,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 0 if summary["reproduced"] else 1
         return 0 if res.ok else 1
 
+    latency_table = ()
+    if args.latency_from_trace:
+        from bluefog_tpu.sim.latency import load_trace_latency
+        try:
+            latency_table = load_trace_latency(args.latency_from_trace)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            raise SystemExit(f"bftpu-sim: --latency-from-trace: {e}")
+        if not args.json:
+            print(f"bftpu-sim: latency fitted to "
+                  f"{len(latency_table)} traced edge(s) from "
+                  f"{args.latency_from_trace}")
     cfg = SimConfig(
         ranks=args.ranks, rounds=args.rounds, seed=args.seed,
         topology=args.topology, faults=tuple(args.faults),
@@ -172,6 +219,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         journal_dir=args.journal_dir,
         debug_bugs=tuple(args.debug_bug),
         quorum=args.quorum,
+        serve_every=args.serve_every,
+        serve_replicas=args.serve_replicas,
+        arrivals=args.arrivals,
+        arrival_rate=args.arrival_rate,
+        request_slo_s=args.request_slo_ms / 1000.0,
+        request_staleness_slo=args.request_staleness_slo,
+        latency_table=latency_table,
     )
     schedule = None
     if args.schedule:

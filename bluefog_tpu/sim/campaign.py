@@ -119,6 +119,30 @@ class SimConfig:
     distrib_slo: int = 0
     distrib_join_round: int = 0
     distrib_join_n: int = 0
+    # serve traffic model (bluefog_tpu.serve.loadgen analog): arrivals
+    # = "poisson" | "fixed" replays the load generator's open-loop
+    # arrival process against the replica models on the VIRTUAL clock —
+    # arrival_rate requests/virtual-second per replica, schedules drawn
+    # from the same pure arrival_times() the real driver uses (a
+    # dedicated seed stream: arming traffic never perturbs existing
+    # digests).  Two standing invariants arm with it: every admitted
+    # request is served within request_slo_s (0 = 2×round_period) or
+    # its violation overlaps an injected fault window (replica kill /
+    # publish churn / tree re-parent), and request_staleness_slo > 0
+    # bounds the served version lag the same way.  Requests are charged
+    # open-loop (latency from the SCHEDULED send), and the open-loop
+    # invariant fires if a drain ever re-anchors a send time
+    # (coordinated omission).  All default OFF.
+    arrivals: str = ""
+    arrival_rate: float = 2.0
+    request_slo_s: float = 0.0
+    request_staleness_slo: int = 0
+    # trace-fitted gossip latency (ROADMAP item 4): per-edge empirical
+    # quantile anchors ((edge_key, p50_s, p99_s), ...) with edge_key
+    # "u->v" or "*" — loaded from a merged trace's critical-path report
+    # by ``python -m bluefog_tpu.sim --latency-from-trace``.  Empty ()
+    # keeps the uniform latency_s draw (existing digests unchanged).
+    latency_table: Tuple = ()
     # plumbing
     max_events: int = 20_000_000
     journal_dir: Optional[str] = None
@@ -132,7 +156,12 @@ class SimConfig:
     # distrib_degree_overflow (tree repair ignores the fan-out cap, so
     # a re-parent overloads a relay — the tree-validity invariant
     # fires), distrib_stall (children of a dead relay never re-parent —
-    # the staleness-SLO invariant fires)
+    # the staleness-SLO invariant fires), slo_silent_violation (a
+    # replica drains its request queue only every third poll, so
+    # queueing delay silently exceeds the request SLO with no fault to
+    # blame — the request-slo invariant fires), loadgen_omission (the
+    # drain re-anchors each request's send time to "now", hiding the
+    # queueing delay — the open-loop invariant fires)
     debug_bugs: Tuple[str, ...] = ()
     # convergence observatory (bluefog_tpu.lab): record per-rank
     # successive-estimate differences each round.  The trace rides in
@@ -151,6 +180,7 @@ class SimConfig:
         d["faults"] = list(self.faults)
         d["latency_s"] = list(self.latency_s)
         d["debug_bugs"] = list(self.debug_bugs)
+        d["latency_table"] = [list(row) for row in self.latency_table]
         return d
 
     @classmethod
@@ -160,6 +190,10 @@ class SimConfig:
         for tup in ("faults", "latency_s", "debug_bugs"):
             if tup in kw and kw[tup] is not None:
                 kw[tup] = tuple(kw[tup])
+        if kw.get("latency_table") is not None:
+            # nested: JSON round-trips the anchor rows as lists
+            kw["latency_table"] = tuple(
+                tuple(row) for row in kw["latency_table"])
         return cls(**kw)
 
 
@@ -193,6 +227,8 @@ class CampaignResult:
             "events": self.events,
             "loop_events": self.loop_events,
             "faults": len(self.schedule),
+            **({"arrivals": self.final["arrivals"]}
+               if "arrivals" in self.final else {}),
         }
 
 

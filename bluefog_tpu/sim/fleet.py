@@ -198,6 +198,36 @@ class SimFleet:
         #: committed version -> virtual commit instant (feeds the
         #: per-edge propagation gate; never reaches the event log)
         self._serve_commit_t: Dict[int, float] = {}
+        # serve traffic model (cfg.arrivals, armed only with the serve
+        # plane): per-replica open-loop request schedules precomputed
+        # from the SAME pure arrival_times() the real load generator
+        # uses (its dedicated ^0x10AD seed stream — arming traffic
+        # draws nothing from self.rng, so existing digests hold).
+        # Fault windows carry the attribution story: a request-SLO or
+        # staleness-SLO miss is a violation only when NO injected
+        # fault window overlaps it.
+        self._arrivals = (str(getattr(cfg, "arrivals", "") or "")
+                          if self._serve_every > 0
+                          and self._serve_replica_n > 0 else "")
+        self._req_slo = float(getattr(cfg, "request_slo_s", 0.0) or 0.0)
+        if self._arrivals and self._req_slo <= 0:
+            self._req_slo = 2.0 * cfg.round_period
+        self._req_stale_slo = int(
+            getattr(cfg, "request_staleness_slo", 0) or 0)
+        self._req_served = 0
+        self._req_violations = 0
+        self._req_attributed = 0
+        self._arr_windows: List[dict] = []
+        self._arr_kill_open: Dict[int, dict] = {}
+        self._arr_stale_open: List[dict] = []
+        # trace-fitted per-edge gossip latency (cfg.latency_table):
+        # replaces the uniform draw in _send with an empirical quantile
+        # sampler consuming exactly one rng.random() per edge
+        self._lat_model = None
+        _table = getattr(cfg, "latency_table", ()) or ()
+        if _table:
+            from bluefog_tpu.sim.latency import EmpiricalLatency
+            self._lat_model = EmpiricalLatency(_table)
         # faults indexed by (victim global rank, step); joins and
         # partitions fire on their own timers (no single victim);
         # serve faults key on replica id / publish ordinal instead of
@@ -318,6 +348,13 @@ class SimFleet:
                     "version": 0, "payload": None, "swaps": 0,
                     "steps": 0, "killed": False, "fired": False,
                     "install_t": 0.0}
+                # traffic starts after the first publish + one adopt
+                # poll can have landed — a request against a replica
+                # that CANNOT have a snapshot yet is a model artifact,
+                # not an SLO story
+                self._arm_arrivals(
+                    self._serve_replicas[i], i,
+                    _T0 + (self._serve_every + 2) * cfg.round_period)
                 if self._distrib_fanout > 0:
                     from bluefog_tpu.serve.distrib import tree as _dtree
                     self._distrib_parents[i] = _dtree.parent_of(
@@ -437,6 +474,12 @@ class SimFleet:
             self.transport.lost_p += r.p
             r.x = 0.0
             r.p = 0.0
+            if self._arrivals:
+                # a gossip-rank death can stall the publish cadence
+                # (heal + quorum re-fence) — staleness is excused
+                # fleet-wide until the next successful commit
+                self._arr_stale_open.append(self._arr_window(
+                    "rank_fault", -1, ("staleness",), None))
             self._check("kill", r.g)
             return True
         if f.kind == "suspend":
@@ -444,6 +487,9 @@ class SimFleet:
             self._log("suspend", r.g, step=r.round_idx, duration=dur)
             r.suspended_until = self.loop.now + dur
             self.loop.at(r.suspended_until, self._round_event(r.g))
+            if self._arrivals:
+                self._arr_stale_open.append(self._arr_window(
+                    "rank_fault", -1, ("staleness",), None))
             self._check("suspend", r.g)
             return True
         if f.kind == "slow":
@@ -904,6 +950,9 @@ class SimFleet:
                 self._serve_commit(r.g, version, payload, repaired=True)
             # payload phase: standby buffer torn (odd seq), header
             # intact — nothing commits, survivors keep the old version
+            if self._arrivals:
+                self._arr_stale_open.append(self._arr_window(
+                    "pub_kill", -1, ("staleness",), None))
             self._kill_rank(r)
             self._check("serve_pub_kill", r.g)
             return
@@ -918,6 +967,16 @@ class SimFleet:
         self._serve_version = max(self._serve_version, version)
         self._serve_committed.append((version, payload))
         self._serve_commit_t[version] = self.loop.now
+        if self._arrivals:
+            # a successful commit bounds every open staleness excuse:
+            # replicas have one propagation pad to catch up, then the
+            # staleness SLO re-arms
+            pad = self._arr_pad()
+            for w in self._arr_stale_open:
+                w["t1"] = self.loop.now + pad
+            self._arr_stale_open = []
+            self._arr_window("publish", -1, ("staleness",),
+                             self.loop.now + pad)
         aux = {"repaired": True} if repaired else {}
         self._log("serve_publish", g, version=version, **aux)
 
@@ -947,6 +1006,13 @@ class SimFleet:
                     and i not in self._distrib_parents):
                 self._distrib_place(i)
             self._log("serve_replica_join", 1000 + i)
+            w = self._arr_kill_open.pop(i, None)
+            if w is not None:
+                # the respawn needs to re-adopt (possibly down a fresh
+                # tree edge) and drain its backlog before the SLO
+                # re-arms for this replica
+                w["t1"] = self.loop.now + self._arr_pad() \
+                    + self.cfg.round_period
             self.loop.after(0.0, self._serve_replica_event(i))
         return fire
 
@@ -980,6 +1046,8 @@ class SimFleet:
                 self._violate("serve-committed",
                               f"replica {i}: {err}", 1000 + i)
             rep["steps"] += 1
+            if self._arrivals:
+                self._drain_requests(i, rep)
 
     def _serve_replica_adopt(self, i: int, rep: dict, version: int,
                              payload: float) -> bool:
@@ -1002,6 +1070,11 @@ class SimFleet:
                 self.loop.at(
                     _T0 + f.stop * self.cfg.round_period,
                     self._serve_replica_join_event(i))
+            if self._arrivals:
+                # every request this replica queues from here until its
+                # respawn (plus one adopt+drain pad) has a cause
+                self._arr_kill_open[i] = self._arr_window(
+                    "replica_kill", i, ("latency", "staleness"), None)
             return False
         err = _inv.check_serve_version_monotone(rep["version"],
                                                 version)
@@ -1020,6 +1093,153 @@ class SimFleet:
         rep["install_t"] = self.loop.now
         self._log("serve_swap", 1000 + i, version=version)
         return True
+
+    # -- serve traffic model (loadgen analog) ------------------------------
+
+    def _arm_arrivals(self, rep: dict, i: int, t_start: float) -> None:
+        """Precompute replica ``i``'s open-loop arrival schedule on the
+        virtual clock (absolute instants).  The schedule is fixed here,
+        before any request fires, and NEVER re-anchored — a killed
+        replica's requests keep arriving and queue against its respawn,
+        exactly like the real driver's overdue backlog."""
+        if not self._arrivals:
+            return
+        cfg = self.cfg
+        horizon = _T0 + (cfg.rounds + cfg.quiesce_rounds) \
+            * cfg.round_period
+        dur = horizon - t_start
+        rep["drains"] = 0
+        rep["arr_i"] = 0
+        if dur <= 0:
+            rep["arr"] = []
+            return
+        from bluefog_tpu.serve.loadgen.arrivals import arrival_times
+        offs = arrival_times(self._arrivals, cfg.arrival_rate, dur,
+                             int(cfg.seed), stream=i)
+        rep["arr"] = [t_start + o for o in offs]
+
+    def _arr_pad(self) -> float:
+        """How long after a cause event its staleness effect may
+        legitimately linger: one adopt poll plus propagation down the
+        deepest feed chain (tree-fed fleets adopt one hop per poll)."""
+        depth = 0
+        if self._distrib_fanout > 0:
+            from bluefog_tpu.serve.distrib import tree as _dtree
+            depth = _dtree.tree_depth(self._distrib_parents)
+        lo, hi = self.cfg.latency_s
+        return (depth + 1) * (self.cfg.round_period + float(hi)) \
+            + self.cfg.round_period
+
+    def _arr_window(self, kind: str, replica: int, covers: tuple,
+                    t1: Optional[float]) -> dict:
+        w = {"kind": kind, "replica": int(replica),
+             "t0": self.loop.now, "t1": t1, "covers": covers}
+        self._arr_windows.append(w)
+        return w
+
+    def _arr_attributed(self, i: int, kind: str, t0: float,
+                        t1: float) -> bool:
+        """Does any injected-fault window that covers failure mode
+        ``kind`` (for replica ``i`` or fleet-wide) overlap [t0, t1]?"""
+        for w in self._arr_windows:
+            if kind not in w["covers"]:
+                continue
+            if w["replica"] not in (-1, i):
+                continue
+            wt1 = w["t1"] if w["t1"] is not None else float("inf")
+            if t0 <= wt1 and w["t0"] <= t1:
+                return True
+        return False
+
+    def _drain_requests(self, i: int, rep: dict) -> None:
+        """Serve every admitted request (scheduled instant <= now) at
+        replica ``i``, charging open-loop latency and auditing both
+        request invariants per request."""
+        arr = rep.get("arr")
+        if not arr:
+            return
+        rep["drains"] += 1
+        if ("slo_silent_violation" in self.cfg.debug_bugs
+                and rep["drains"] % 3 != 1):
+            return  # seeded bug: the queue sits through two polls
+        now = self.loop.now
+        k = rep["arr_i"]
+        n = 0
+        worst = 0.0
+        lag = self._serve_version - rep["version"]
+        while k < len(arr) and arr[k] <= now:
+            sched = arr[k]
+            charged = sched
+            if "loadgen_omission" in self.cfg.debug_bugs:
+                charged = now  # seeded bug: re-anchor the send time
+            err = _inv.check_open_loop(sched, charged)
+            if err:
+                self._req_violations += 1
+                self._violate("open-loop", err, 1000 + i)
+            latency = now - charged
+            self._req_served += 1
+            if self._req_slo > 0 and latency > self._req_slo:
+                att = self._arr_attributed(i, "latency", sched, now)
+                if att:
+                    self._req_attributed += 1
+                err = _inv.check_request_slo(i, latency, self._req_slo,
+                                             att)
+                if err:
+                    self._req_violations += 1
+                    self._violate("request-slo", err, 1000 + i)
+            if self._req_stale_slo > 0 and lag > self._req_stale_slo:
+                att = self._arr_attributed(i, "staleness", sched, now)
+                if att:
+                    self._req_attributed += 1
+                err = _inv.check_request_staleness(
+                    i, lag, self._req_stale_slo, att)
+                if err:
+                    self._req_violations += 1
+                    self._violate("request-staleness", err, 1000 + i)
+            worst = max(worst, latency)
+            k += 1
+            n += 1
+        if n:
+            rep["arr_i"] = k
+            self._log("serve_requests", 1000 + i, n=n,
+                      worst=round(worst, 9), lag=lag)
+
+    def _check_arrivals(self, point: str, g: int) -> None:
+        """The standing form of the two request invariants, audited
+        after every protocol event: no live replica may be sitting on
+        a queued request already past the SLO, or serving further
+        behind the head than the staleness SLO, without a fault window
+        to blame — catches a silent stall BEFORE the drain would."""
+        if not self._arrivals:
+            return
+        now = self.loop.now
+        for i, rep in self._serve_replicas.items():
+            arr = rep.get("arr")
+            if not arr or rep["killed"] or rep["payload"] is None:
+                continue  # kill/warmup paths are audited at drain time
+            k = rep["arr_i"]
+            if k < len(arr) and self._req_slo > 0:
+                age = now - arr[k]
+                if age > self._req_slo and not self._arr_attributed(
+                        i, "latency", arr[k], now):
+                    err = _inv.check_request_slo(i, age, self._req_slo,
+                                                 False)
+                    if err:
+                        self._req_violations += 1
+                        self._violate("request-slo",
+                                      f"at {point} (queued): {err}",
+                                      1000 + i)
+            if self._req_stale_slo > 0:
+                lag = self._serve_version - rep["version"]
+                if lag > self._req_stale_slo \
+                        and not self._arr_attributed(
+                            i, "staleness", now, now):
+                    err = _inv.check_request_staleness(
+                        i, lag, self._req_stale_slo, False)
+                    if err:
+                        self._req_violations += 1
+                        self._violate("request-staleness",
+                                      f"at {point}: {err}", 1000 + i)
 
     # -- distribution tree (serve.distrib model) ---------------------------
 
@@ -1111,6 +1331,9 @@ class SimFleet:
                     "version": 0, "payload": None, "swaps": 0,
                     "steps": 0, "killed": False, "fired": False,
                     "install_t": 0.0}
+                self._arm_arrivals(
+                    self._serve_replicas[i], i,
+                    self.loop.now + 2 * self.cfg.round_period)
                 self._distrib_place(i)
                 off = ((1000 + i) * 37 % 101) / 101.0
                 self.loop.after(off * self.cfg.round_period,
@@ -1241,7 +1464,8 @@ class SimFleet:
                 # degraded send: the weight a dead neighbor would have
                 # received stays with the sender (mass-conserving)
                 continue
-            lat = self.rng.uniform(lo, hi)
+            lat = (self.rng.uniform(lo, hi) if self._lat_model is None
+                   else self._lat_model.sample(r.g, dst, self.rng))
             mx = w * r.x
             mp = w * r.p
             sent_x += mx
@@ -1323,6 +1547,7 @@ class SimFleet:
             if err:
                 self._violate("minority-demotion",
                               f"committed at {point}: {err}", g)
+        self._check_arrivals(point, g)
 
     def run(self) -> None:
         self.loop.run(max_events=self.cfg.max_events)
@@ -1406,6 +1631,45 @@ class SimFleet:
                     "depth": _dtree.tree_depth(self._distrib_parents),
                     "reparents": self._distrib_reparents,
                     "joins": self._distrib_joins,
+                }
+            if self._arrivals:
+                # requests admitted before end-of-campaign that never
+                # drained (their replica died and stayed dead) must
+                # still be accounted: attributed to the open kill
+                # window, or a silent drop
+                now = self.loop.now
+                for i, rep in sorted(self._serve_replicas.items()):
+                    arr = rep.get("arr")
+                    if not arr:
+                        continue
+                    k = rep["arr_i"]
+                    while k < len(arr) and arr[k] <= now:
+                        if self._arr_attributed(i, "latency",
+                                                arr[k], now):
+                            self._req_attributed += 1
+                        else:
+                            self._req_violations += 1
+                            self._violate(
+                                "request-slo",
+                                f"at finalize (unserved): replica {i} "
+                                f"request scheduled at t={arr[k]:.3f} "
+                                "was never served and no fault window "
+                                "explains the drop", 1000 + i)
+                        k += 1
+                    rep["arr_i"] = k
+                out["arrivals"] = {
+                    "process": self._arrivals,
+                    "rate": self.cfg.arrival_rate,
+                    "slo_s": self._req_slo,
+                    "staleness_slo": self._req_stale_slo,
+                    "admitted": sum(
+                        rep["arr_i"]
+                        for rep in self._serve_replicas.values()
+                        if rep.get("arr") is not None),
+                    "served": self._req_served,
+                    "violations": self._req_violations,
+                    "attributed": self._req_attributed,
+                    "windows": len(self._arr_windows),
                 }
         return out
 

@@ -35,6 +35,9 @@ __all__ = [
     "check_serve_snapshot_committed",
     "check_distrib_tree",
     "check_distrib_staleness",
+    "check_request_slo",
+    "check_request_staleness",
+    "check_open_loop",
     "demotion_cap",
 ]
 
@@ -222,6 +225,49 @@ def check_distrib_staleness(replica: int, lag: int,
                 f"the publisher (staleness SLO {slo}) — its feed path "
                 "stalled (dead relay never re-parented?)")
     return None
+
+
+def check_request_slo(replica: int, latency_s: float, slo_s: float,
+                      attributed: bool) -> Optional[str]:
+    """Every admitted serve request completes within the latency SLO
+    *or* its violation overlaps an injected fault window (a replica
+    kill, publisher death, tree re-parent).  ``attributed=True`` means
+    the campaign found such a window — a violation with a cause is the
+    system degrading as designed; one without is a silent SLO hole
+    (e.g. a drain path that skips polls)."""
+    if slo_s <= 0 or latency_s <= slo_s or attributed:
+        return None
+    return (f"replica {replica}: request latency {latency_s:.3f}s "
+            f"exceeds the {slo_s:.3f}s SLO with NO fault window to "
+            "attribute it to — a silent serve-path stall")
+
+
+def check_request_staleness(replica: int, lag: int, slo: int,
+                            attributed: bool) -> Optional[str]:
+    """A request must be served within ``slo`` versions of the
+    committed head (0 = unbounded) unless publish churn / a kill / a
+    re-parent window explains the trail — the staleness-SLO twin of
+    :func:`check_request_slo`, audited per served request under
+    churn."""
+    if slo <= 0 or lag <= slo or attributed:
+        return None
+    return (f"replica {replica} served a request {lag} versions stale "
+            f"(staleness SLO {slo}) outside every fault window — the "
+            "swap path fell behind with nothing to blame")
+
+
+def check_open_loop(sched_t: float, charged_t: float,
+                    tol: float = 1e-9) -> Optional[str]:
+    """The open-loop contract: latency is charged from the SCHEDULED
+    send instant, never re-anchored to when the server got around to
+    it.  A drain that rewrites send times hides queueing delay —
+    coordinated omission, the measurement bug the real load generator
+    exists to avoid."""
+    if charged_t <= sched_t + tol:
+        return None
+    return (f"request scheduled at t={sched_t:.3f} had latency charged "
+            f"from t={charged_t:.3f} — the drain re-anchored the send "
+            "time (coordinated omission: queueing delay vanished)")
 
 
 def check_consensus(estimates: Dict[int, float], tol: float = 1e-6,

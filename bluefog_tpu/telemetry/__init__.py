@@ -23,6 +23,7 @@ Stdlib-only: importable without jax, numpy, or the native library.
 
 from bluefog_tpu.telemetry.registry import (
     DEFAULT_LATENCY_BUCKETS_S,
+    SERVE_LATENCY_BUCKETS_S,
     LEDGER_COLLECTED,
     LEDGER_DEPOSITS,
     LEDGER_DRAINED,
@@ -57,6 +58,7 @@ __all__ = [
     "SNAPSHOT_SCHEMA",
     "MERGED_SCHEMA",
     "DEFAULT_LATENCY_BUCKETS_S",
+    "SERVE_LATENCY_BUCKETS_S",
     "LEDGER_DEPOSITS",
     "LEDGER_COLLECTED",
     "LEDGER_DRAINED",

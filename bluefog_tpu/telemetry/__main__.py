@@ -2,6 +2,7 @@
 
     python -m bluefog_tpu.telemetry SNAP_OR_DIR [...] [--format json|prom|both]
                                     [--out PATH] [--check]
+                                    [--slo-report] [--slo-margin-s S]
 
 Positional arguments are snapshot files or directories (directories are
 globbed for ``telemetry-*.json``; previously merged summaries are
@@ -10,7 +11,15 @@ skipped by schema tag).  With no arguments the default telemetry dir
 is scanned.
 
 ``--check`` runs the telemetry analysis rules (snapshot schema +
-conservation invariant) over the corpus and exits non-zero on findings.
+conservation invariant) over the corpus, plus the ``serve_request``
+journal-record schema when event journals sit alongside the snapshots,
+and exits non-zero on findings.
+
+``--slo-report`` switches to the request-level journals instead: SLO
+violation windows (journaled by the per-replica monitor) are joined to
+the cause events that explain them (publishes, swaps, staleness
+retries, tree churn) on the shared wall clock.  Exits non-zero when any
+window has no overlapping cause — an *unexplained* violation.
 """
 
 from __future__ import annotations
@@ -22,9 +31,11 @@ import sys
 from typing import List
 
 from bluefog_tpu.telemetry.merge import (
+    check_request_records,
     find_snapshots,
     load_snapshot,
     merge_snapshots,
+    slo_report,
     to_prometheus,
 )
 from bluefog_tpu.telemetry.registry import _DEFAULT_DIR, telemetry_dir
@@ -48,9 +59,38 @@ def main(argv: List[str] = None) -> int:
                     help="write output to PATH instead of stdout "
                          "(with --format both, PATH and PATH.prom)")
     ap.add_argument("--check", action="store_true",
-                    help="run telemetry analysis rules over the corpus; "
+                    help="run telemetry analysis rules over the corpus "
+                         "(snapshots + serve_request journal schema); "
                          "exit non-zero on findings")
+    ap.add_argument("--slo-report", action="store_true",
+                    help="join SLO violation windows in the event "
+                         "journals to their cause events; exit non-zero "
+                         "on unattributed windows")
+    ap.add_argument("--slo-margin-s", type=float, default=2.0,
+                    help="cause-join slack around each violation window "
+                         "(seconds, default: 2.0)")
     args = ap.parse_args(argv)
+
+    if args.slo_report:
+        report = slo_report(args.paths or _default_paths(),
+                            margin_s=args.slo_margin_s)
+        text = json.dumps(report, indent=2)
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                f.write(text + "\n")
+        else:
+            print(text)
+        if not report["journals"]:
+            print("error: no event journals found (run with "
+                  "BFTPU_TELEMETRY=1, or pass journal paths)",
+                  file=sys.stderr)
+            return 2
+        print(f"slo report: {report['total_windows']} violation "
+              f"window(s) over {report['requests']} request(s) in "
+              f"{len(report['journals'])} journal(s), "
+              f"{report['unattributed']} unattributed",
+              file=sys.stderr)
+        return 1 if report["unattributed"] else 0
 
     paths = find_snapshots(args.paths or _default_paths())
     snaps = []
@@ -102,13 +142,17 @@ def main(argv: List[str] = None) -> int:
         for f in findings:
             print(f"CHECK {f.severity}: [{f.rule}] {f.subject}: {f.message}",
                   file=sys.stderr)
+        req_errors = check_request_records(args.paths or _default_paths())
+        for msg in req_errors:
+            print(f"CHECK error: [telemetry.request-journal] {msg}",
+                  file=sys.stderr)
         if skipped:
             # an unreadable rank means the corpus (and thus the ledger
             # verdict) is incomplete — note it and fail the check
             print(f"CHECK warning: [telemetry.merge-skipped] "
                   f"{len(skipped)} snapshot(s) unreadable/truncated: "
                   f"{', '.join(skipped)}", file=sys.stderr)
-        if findings or skipped:
+        if findings or req_errors or skipped:
             rc = 1
         else:
             led = merged["ledger"]

@@ -30,15 +30,39 @@ from bluefog_tpu.telemetry.registry import (
 
 __all__ = [
     "MERGED_SCHEMA",
+    "SLO_REPORT_SCHEMA",
+    "SLO_CAUSE_KINDS",
     "find_snapshots",
+    "find_journals",
     "load_snapshot",
+    "read_journal",
     "merge_snapshots",
     "ledger_balance",
     "to_prometheus",
     "merge_job_snapshots",
+    "slo_report",
+    "check_request_records",
 ]
 
 MERGED_SCHEMA = "bftpu-telemetry-merged/1"
+SLO_REPORT_SCHEMA = "bftpu-slo-report/1"
+
+#: Journal event kinds that can *explain* an SLO violation window: weight
+#: publication and swap activity, staleness rejections and their retries,
+#: distribution-tree churn, and the start of a load phase (warm-up).  A
+#: chaos harness that SIGKILLs replicas journals ``serve_respawn`` from
+#: the parent; it joins here too.
+SLO_CAUSE_KINDS = (
+    "serve_publish",
+    "serve_swap",
+    "serve_retry",
+    "serve_stale",
+    "serve_respawn",
+    "distrib_publish",
+    "distrib_reparent",
+    "distrib_resync",
+    "loadgen_start",
+)
 
 
 def find_snapshots(paths: Iterable[str]) -> List[str]:
@@ -233,3 +257,173 @@ def merge_job_snapshots(dir_value: Optional[str], job: str) -> Optional[str]:
     with open(out[:-len(".json")] + ".prom", "w", encoding="utf-8") as f:
         f.write(to_prometheus(merged))
     return out
+
+
+# -- request-level journals: SLO windows joined to causes -------------------
+
+def find_journals(paths: Iterable[str]) -> List[str]:
+    """Expand files/directories into event-journal paths.  A directory
+    yields every ``telemetry-*.events.jsonl`` in it plus rotated ``.1``
+    generations; explicit files pass through when they look like
+    journals."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                glob.glob(os.path.join(p, "telemetry-*.events.jsonl"))))
+            out.extend(sorted(
+                glob.glob(os.path.join(p, "telemetry-*.events.jsonl.1"))))
+        elif ".events.jsonl" in os.path.basename(p):
+            out.append(p)
+    return out
+
+
+def read_journal(path: str) -> List[dict]:
+    """Parsed event records from one journal.  Corrupt lines are skipped
+    (a SIGKILLed rank tears at most the line in flight), as is an
+    unreadable file — survivors' journals still merge."""
+    events: List[dict] = []
+    try:
+        fh = open(path, "r", encoding="utf-8")
+    except OSError:
+        return events
+    with fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                events.append(rec)
+    return events
+
+
+def _num(v) -> Optional[float]:
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    f = float(v)
+    return f if f == f and f not in (float("inf"), float("-inf")) else None
+
+
+def slo_report(paths: Iterable[str], margin_s: float = 2.0) -> dict:
+    """Join SLO violation windows to the cause events that explain them.
+
+    Reads every journal under ``paths``, collects ``slo_violation``
+    windows (written by the per-replica SLO monitor with wall-clock
+    bounds) and :data:`SLO_CAUSE_KINDS` events, and attributes each
+    window to every cause whose universal ``ts`` falls within
+    ``[t0_wall - margin_s, t1_wall + margin_s]`` — wall clock is the one
+    timebase journals from different processes share.  A window no cause
+    overlaps counts as *unattributed*: in a chaos run those are the
+    unexplained violations the acceptance gate requires to be zero.
+    """
+    journals = find_journals(paths)
+    windows: List[dict] = []
+    causes: List[dict] = []
+    requests = 0
+    for path in journals:
+        name = os.path.basename(path)
+        for rec in read_journal(path):
+            kind = rec.get("event")
+            if kind == "slo_violation":
+                w = dict(rec)
+                w["_journal"] = name
+                windows.append(w)
+            elif kind in SLO_CAUSE_KINDS:
+                causes.append(rec)
+            elif kind == "serve_request":
+                requests += 1
+    causes.sort(key=lambda r: _num(r.get("ts")) or 0.0)
+    out_windows: List[dict] = []
+    unattributed = 0
+    for w in sorted(windows, key=lambda r: _num(r.get("t0_wall")) or 0.0):
+        t0 = _num(w.get("t0_wall"))
+        t1 = _num(w.get("t1_wall"))
+        joined = []
+        if t0 is not None:
+            lo, hi = t0 - margin_s, (t1 if t1 is not None else t0) + margin_s
+            for c in causes:
+                ts = _num(c.get("ts"))
+                if ts is None or not (lo <= ts <= hi):
+                    continue
+                cause = {"kind": c.get("event"), "ts": ts,
+                         "rank": c.get("rank"), "dt_s": ts - t0}
+                for k in ("replica", "win", "version", "group"):
+                    if k in c:
+                        cause[k] = c[k]
+                joined.append(cause)
+        if not joined:
+            unattributed += 1
+        out_windows.append({
+            "replica": w.get("replica"),
+            "t0_wall": w.get("t0_wall"),
+            "t1_wall": w.get("t1_wall"),
+            "duration_s": (t1 - t0 if t0 is not None and t1 is not None
+                           else None),
+            "requests": w.get("requests"),
+            "worst_ms": w.get("worst_ms"),
+            "kinds": w.get("kinds"),
+            "journal": w.get("_journal"),
+            "causes": joined,
+        })
+    return {
+        "schema": SLO_REPORT_SCHEMA,
+        "journals": [os.path.basename(p) for p in journals],
+        "margin_s": float(margin_s),
+        "requests": requests,
+        "windows": out_windows,
+        "total_windows": len(out_windows),
+        "unattributed": unattributed,
+    }
+
+
+#: serve_request fields every writer (Replica.note_request and the
+#: loadgen's registry fallback) must journal as finite numbers.
+_REQUEST_NUM_FIELDS = ("send_mono", "start_mono", "done_mono", "latency_ms")
+
+
+def check_request_records(paths: Iterable[str]) -> List[str]:
+    """Validate ``serve_request`` journal records; one error string per
+    malformed record.  The schema is what downstream joins rely on:
+    finite monotonic timestamps ordered send <= done, a latency
+    consistent with them on the open-loop basis (charged from the
+    *scheduled* send), and a non-empty outcome label."""
+    errors: List[str] = []
+    for path in find_journals(paths):
+        name = os.path.basename(path)
+        for i, rec in enumerate(read_journal(path)):
+            if rec.get("event") != "serve_request":
+                continue
+            where = f"{name}: serve_request #{i}"
+            nums = {}
+            bad = False
+            for fld in _REQUEST_NUM_FIELDS:
+                v = _num(rec.get(fld))
+                if v is None:
+                    errors.append(f"{where}: field {fld!r} missing or "
+                                  f"not a finite number: "
+                                  f"{rec.get(fld)!r}")
+                    bad = True
+                nums[fld] = v
+            if not bad:
+                if nums["done_mono"] < nums["send_mono"]:
+                    errors.append(f"{where}: done_mono precedes send_mono "
+                                  f"({nums['done_mono']} < "
+                                  f"{nums['send_mono']})")
+                else:
+                    want = (nums["done_mono"] - nums["send_mono"]) * 1e3
+                    if abs(nums["latency_ms"] - want) > 0.5:
+                        errors.append(
+                            f"{where}: latency_ms={nums['latency_ms']:.3f} "
+                            f"inconsistent with done-send="
+                            f"{want:.3f} ms (open-loop basis)")
+            out = rec.get("outcome")
+            if not isinstance(out, str) or not out:
+                errors.append(f"{where}: outcome missing or not a "
+                              f"non-empty string: {out!r}")
+            if "replica" not in rec:
+                errors.append(f"{where}: replica missing")
+    return errors

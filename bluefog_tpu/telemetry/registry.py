@@ -47,6 +47,7 @@ __all__ = [
     "LEDGER_PENDING",
     "MASS_JOIN_ADMITTED",
     "DEFAULT_LATENCY_BUCKETS_S",
+    "SERVE_LATENCY_BUCKETS_S",
     "quantile_from_buckets",
     "telemetry_dir",
     "Counter",
@@ -94,6 +95,16 @@ DEFAULT_LATENCY_BUCKETS_S: Tuple[float, ...] = (
     1e-6, 5e-6, 1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3,
     1e-2, 5e-2, 1e-1, 5e-1, 1.0, 5.0, 10.0,
 )
+
+#: Log-spaced buckets for request-level serve latency (0.1 ms .. ~2.2 s
+#: in 30 steps of 10^0.15 ≈ 1.41x).  The half-decade DEFAULT buckets
+#: give the tail quantile only 2 edges per decade — a p99 interpolated
+#: between 0.5 s and 1.0 s is useless for an SLO at 250 ms; constant
+#: RELATIVE resolution (~41% per bucket, ~6.7 edges/decade) keeps the
+#: p99 estimate within one bucket ratio anywhere in the 0.1 ms–2 s
+#: open-loop tail the load generator charges queueing delay into.
+SERVE_LATENCY_BUCKETS_S: Tuple[float, ...] = tuple(
+    round(10.0 ** (-4 + 0.15 * i), 10) for i in range(30))
 
 _DEFAULT_DIR = "/tmp/bftpu_telemetry"
 

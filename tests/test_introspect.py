@@ -93,6 +93,85 @@ def test_status_page_rejects_foreign_layout(shm_dir):
         page.close(unlink=True)
 
 
+# every historical fixed-block layout, oldest first — a mid-upgrade
+# fleet has live writers at any of these versions at once
+_V_STRUCTS = {1: sp._FIXED_V1, 2: sp._FIXED_V2, 3: sp._FIXED_V3,
+              4: sp._FIXED_V4, 5: sp._FIXED_V5, 6: sp._FIXED_V6}
+
+
+def _pack_legacy_page(version, seg, rank=0):
+    fields = [rank, 2, os.getpid(), 0, 9, 1, 5,
+              time.time(), time.monotonic(), b"op", 4.0, 2.0, 1.0, 1.0]
+    if version >= 2:
+        fields += [3, b"win"]          # qdepth, inflight
+    if version >= 3:
+        fields += [0.5, 7]             # conv_err, conv_round
+    if version >= 4:
+        fields += [0]                  # flags
+    if version >= 5:
+        fields += [11, 2]              # serve_version, serve_lag
+    if version >= 6:
+        fields += [1, 0]               # distrib_slot, distrib_parent
+    sp._HEAD.pack_into(seg._mm, 0, sp.STATUS_MAGIC, version, 2)
+    _V_STRUCTS[version].pack_into(seg._mm, sp._HEAD.size, *fields)
+
+
+@pytest.mark.parametrize("version", sorted(_V_STRUCTS))
+def test_status_page_back_compat_every_version_decodes(shm_dir, version):
+    """v1..v6 pages (live writers in a mid-upgrade fleet) decode with
+    the fields their layout lacks defaulted — in particular the v7
+    request-telemetry block reads as "no traffic observed"."""
+    path = sp.status_page_path("compat", version)
+    seg = shm_native._FallbackSegment(path, sp.PAGE_BYTES)
+    try:
+        _pack_legacy_page(version, seg)
+        got = sp.read_status_page(path)
+        assert got["version"] == version
+        assert (got["step"], got["epoch"], got["op_id"]) == (9, 1, 5)
+        assert got["ledger"]["balance"] == pytest.approx(4.0 - 2.0 - 1.0)
+        assert got["serve"]["qps"] == -1.0
+        assert got["serve"]["p50_ms"] == -1.0
+        assert got["serve"]["p99_ms"] == -1.0
+        assert got["serve"]["slo_state"] == -1
+        if version >= 5:
+            assert (got["serve"]["version"], got["serve"]["lag"]) == (11, 2)
+        else:
+            assert (got["serve"]["version"], got["serve"]["lag"]) == (-1, -1)
+        if version >= 6:
+            assert got["distrib"] == {"slot": 1, "parent": 0}
+        else:
+            assert got["distrib"] == {"slot": -1, "parent": -1}
+        if version >= 3:
+            assert got["conv"] == {"err": 0.5, "round": 7}
+    finally:
+        seg.close(unlink=True)
+
+
+def test_fleet_skips_foreign_version_pages(shm_dir):
+    """A rank running a FUTURE build writes a page version this reader
+    does not know: the fleet attach (bftpu-top) reports that rank as an
+    error entry and keeps reading everyone else."""
+    page = sp.StatusPage("mixv", 0)
+    try:
+        page.publish(nranks=2, step=1, epoch=0, op_id=1,
+                     serve_version=3, qps=120.0, p50_ms=1.5, p99_ms=4.0,
+                     slo_state=0)
+        fpath = sp.status_page_path("mixv", 1)
+        seg = shm_native._FallbackSegment(fpath, sp.PAGE_BYTES)
+        sp._HEAD.pack_into(seg._mm, 0, sp.STATUS_MAGIC, 99, 2)
+        fleet = sp.read_fleet("mixv")
+        assert set(fleet) == {0, 1}
+        assert fleet[0]["serve"]["qps"] == pytest.approx(120.0)
+        assert fleet[0]["serve"]["slo_state"] == 0
+        assert "error" in fleet[1] and "version" in fleet[1]["error"]
+        snap = sp.collect("mixv")
+        assert "error" in snap["ranks"]["1"]
+        assert snap["serve"]["0"]["p99_ms"] == pytest.approx(4.0)
+        seg.close(unlink=True)
+    finally:
+        page.close(unlink=True)
+
+
 def test_trace_control_word_generation(shm_dir):
     assert sp.read_trace_control("tc") == (0, sp.TRACE_DEFAULT)
     g1 = sp.publish_trace_control("tc", sp.TRACE_ON)

@@ -315,13 +315,17 @@ def test_status_page_serve_plane_roundtrip(shm_dir):
                      serve_version=7, serve_lag=2)
         got = sp.read_status_page(sp.status_page_path("sv5", 1000))
         assert got["version"] == sp.STATUS_VERSION
-        assert got["serve"] == {"version": 7, "lag": 2}
+        assert got["serve"] == {"version": 7, "lag": 2, "qps": -1.0,
+                                "p50_ms": -1.0, "p99_ms": -1.0,
+                                "slo_state": -1}
         # v6 default: not attached through the distribution tree
         assert got["distrib"] == {"slot": -1, "parent": -1}
         # default: not part of the serve plane
         page.publish(nranks=4, step=4, epoch=1, op_id=3)
         got = sp.read_status_page(sp.status_page_path("sv5", 1000))
-        assert got["serve"] == {"version": -1, "lag": -1}
+        assert got["serve"] == {"version": -1, "lag": -1, "qps": -1.0,
+                                "p50_ms": -1.0, "p99_ms": -1.0,
+                                "slo_state": -1}
     finally:
         page.close(unlink=True)
 
@@ -339,7 +343,9 @@ def test_status_page_v4_decodes_without_serve_plane(shm_dir):
             1.0, 1.0, 0.0, 0.0, -1, b"", -1.0, -1, sp.FLAG_ORPHAN)
         got = sp.read_status_page(path)
         assert got["version"] == 4 and got["orphan"] is True
-        assert got["serve"] == {"version": -1, "lag": -1}
+        assert got["serve"] == {"version": -1, "lag": -1, "qps": -1.0,
+                                "p50_ms": -1.0, "p99_ms": -1.0,
+                                "slo_state": -1}
     finally:
         seg.close(unlink=True)
 
