@@ -15,6 +15,12 @@
 #   make loadgen         serve-traffic gate: the slo rule family (pinned
 #                        Poisson campaigns + latency-sampler pins) plus the
 #                        open-loop load bench arm (benchmarks/serving.py load)
+#   make monitor         fleet-monitor gate: the monitor rule family
+#                        (seeded-bug alert completeness + clean-twin
+#                        false-alarm freedom + window coalescing)
+#   make trend           regression gate over the frozen BENCH_r*.json
+#                        corpus: exit nonzero when any gated headline of
+#                        the newest record regressed > 20%
 #
 # All targets force the CPU backend so they run on any host.
 
@@ -23,7 +29,7 @@ ENV     := JAX_PLATFORMS=cpu
 PYTEST  := $(ENV) $(PY) -m pytest tests/ -q -m 'not slow' \
            --continue-on-collection-errors -p no:cacheprovider
 
-.PHONY: verify analyze selftest changed test distrib loadgen
+.PHONY: verify analyze selftest changed test distrib loadgen monitor trend
 
 verify: selftest analyze test
 
@@ -47,3 +53,9 @@ distrib:
 loadgen:
 	$(ENV) $(PY) -m bluefog_tpu.analysis --family slo
 	$(ENV) $(PY) benchmarks/serving.py load
+
+monitor:
+	$(ENV) $(PY) -m bluefog_tpu.analysis --family monitor
+
+trend:
+	$(ENV) $(PY) bench.py --trend

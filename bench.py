@@ -499,6 +499,18 @@ def main():
             print(json.dumps(lab), file=sys.stderr)
         except Exception as e:  # noqa: BLE001
             print(f"lab probe overhead phase failed: {e!r}", file=sys.stderr)
+    mon = None
+    if time.perf_counter() - t_start < budget_s:
+        try:
+            # fleet-monitor overhead gate (docs/OBSERVABILITY.md "Fleet
+            # monitor"): the same single-process self-edge loop with a
+            # real monitor daemon process attached and scraping at 0.1 s
+            # vs unattached; the passive-scrape contract is < 2%
+            from gossip_bandwidth import measure_monitor_overhead
+            mon = measure_monitor_overhead()
+            print(json.dumps(mon), file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"monitor overhead phase failed: {e!r}", file=sys.stderr)
     rec = None
     if time.perf_counter() - t_start < budget_s:
         try:
@@ -707,6 +719,9 @@ def main():
     if lab is not None:
         headline["lab_probe_overhead_pct"] = lab["value"]
         headline["lab_probe_overhead_metric"] = lab["metric"]
+    if mon is not None:
+        headline["monitor_overhead_pct"] = mon["value"]
+        headline["monitor_overhead_metric"] = mon["metric"]
     if rec is not None:
         headline["recovery_ms"] = rec["value"]
         headline["recovery_metric"] = rec["metric"]
@@ -791,5 +806,131 @@ def main():
     print(json.dumps(headline))
 
 
+# ---------------------------------------------------------------------------
+# --trend: regression gate over the frozen BENCH_r*.json corpus
+# ---------------------------------------------------------------------------
+
+#: headline keys where bigger is better (gate: the newest record must
+#: hold >= TREND_DROP x the best of the last <= 3 priors carrying the key)
+TREND_HIGHER = (
+    "value",
+    "win_put_gossip_bandwidth_gbs",
+    "island_win_put_gbs_per_rank",
+    "tcp_chunked_gbps",
+    "serve_rate_steps_s",
+)
+#: latency keys where smaller is better (gate: <= TREND_RISE x the best
+#: — minimum — of the last <= 3 priors carrying the key)
+TREND_LOWER = (
+    "recovery_ms",
+    "join_ms",
+    "partition_merge_ms",
+    "publish_swap_ms",
+    "distrib_all_swap_ms",
+)
+TREND_DROP = 0.8    # > 20% throughput loss vs the recent best fails
+TREND_RISE = 1.2    # > 20% latency growth vs the recent best fails
+
+
+def _trend_values(doc: dict) -> dict:
+    """Flatten one frozen record to {headline_key: number}.  The corpus
+    spans two shapes: early rounds wrap the bench JSON line under
+    "parsed"; later rounds store per-headline dicts with a "value"."""
+    out = {}
+    for k, v in doc.items():
+        if k in ("round", "n", "rc"):
+            continue
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[k] = float(v)
+        elif isinstance(v, dict) and isinstance(
+                v.get("value"), (int, float)):
+            out[k] = float(v["value"])
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict):
+        for k, v in parsed.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[k] = float(v)
+    return out
+
+
+def load_trend_corpus(dirs=None):
+    """The frozen records as ``(round, path, values)`` sorted by round.
+    Default search: the repo root (rounds 1-5) + benchmarks/ (6+)."""
+    import glob
+    import re
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    dirs = list(dirs) if dirs else [root, os.path.join(root, "benchmarks")]
+    recs = []
+    for d in dirs:
+        for path in glob.glob(os.path.join(d, "BENCH_r*.json")):
+            m = re.fullmatch(r"BENCH_r(\d+)\.json", os.path.basename(path))
+            if not m:
+                continue
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError) as e:
+                print(f"trend: skipping unreadable {path}: {e}",
+                      file=sys.stderr)
+                continue
+            recs.append((int(m.group(1)), path, _trend_values(doc)))
+    recs.sort(key=lambda r: r[0])
+    return recs
+
+
+def trend_main(argv=None) -> int:
+    """``python bench.py --trend``: exit nonzero when any gated headline
+    of the NEWEST frozen record regressed > 20% against the best of the
+    last <= 3 prior records that carry the key.  Keys a record lacks are
+    skipped (headlines are added over time, never back-filled)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="bench.py --trend",
+        description="regression gate over the frozen BENCH_r*.json corpus")
+    ap.add_argument("--dir", action="append", default=None,
+                    help="corpus directory (repeatable; default: repo "
+                         "root + benchmarks/)")
+    args = ap.parse_args(argv)
+
+    recs = load_trend_corpus(args.dir)
+    if len(recs) < 2:
+        print(f"trend: {len(recs)} frozen record(s) — nothing to gate")
+        return 0
+    cur_round, cur_path, cur = recs[-1]
+    priors = recs[:-1]
+    print(f"trend: r{cur_round} ({os.path.basename(cur_path)}) vs "
+          f"{len(priors)} prior record(s)")
+    failures = []
+    for key, higher in ([(k, True) for k in TREND_HIGHER]
+                        + [(k, False) for k in TREND_LOWER]):
+        if key not in cur:
+            continue
+        hist = [(rno, vals[key]) for rno, _p, vals in priors
+                if key in vals][-3:]
+        if not hist:
+            print(f"  {key:<34s} {cur[key]:>12g}  (no prior — baseline)")
+            continue
+        ref = (max if higher else min)(v for _r, v in hist)
+        bound = ref * (TREND_DROP if higher else TREND_RISE)
+        ok = cur[key] >= bound if higher else cur[key] <= bound
+        arrow = ">=" if higher else "<="
+        print(f"  {key:<34s} {cur[key]:>12g}  {arrow} {bound:g} "
+              f"(best {ref:g} over r{hist[0][0]}..r{hist[-1][0]})"
+              f"  {'ok' if ok else 'REGRESSED'}")
+        if not ok:
+            failures.append(key)
+    if failures:
+        print(f"trend: FAIL — {len(failures)} gated headline(s) "
+              f"regressed > 20%: {failures}")
+        return 1
+    print("trend: OK — no gated headline regressed > 20%")
+    return 0
+
+
 if __name__ == "__main__":
+    if "--trend" in sys.argv[1:]:
+        sys.exit(trend_main(
+            [a for a in sys.argv[1:] if a != "--trend"]))
     main()

@@ -399,6 +399,75 @@ def measure_lab_probe_overhead(mb: float = 16.0, iters: int = 100,
     }
 
 
+_MON_JOBS = iter(range(1 << 30))
+
+
+def measure_monitor_overhead(mb: float = 16.0, iters: int = 100,
+                             warmup: int = 10, repeats: int = 5) -> dict:
+    """Monitor-attached vs unattached cost of the island gossip round.
+
+    Same single-process self-edge / per-iteration-median /
+    best-of-``repeats`` protocol as :func:`measure_lab_probe_overhead`,
+    but the toggled variable is a fleet-monitor daemon
+    (``python -m bluefog_tpu.monitor --daemon``) — a SEPARATE process,
+    exactly as deployed — attached to the worker's job and polling its
+    status pages at a 0.1 s cadence (10x the default, so scrapes
+    actually land inside the timed region).  The monitor's contract
+    (docs/OBSERVABILITY.md "Fleet monitor") is that attaching it is
+    free for the run: passive seqlock reads, no locks taken, < 2%.
+    """
+    import functools
+    import subprocess
+
+    from bluefog_tpu import islands
+
+    def one_dt(attach: bool) -> float:
+        job = f"monb{os.getpid()}_{next(_MON_JOBS)}"
+        proc = None
+        if attach:
+            env = dict(os.environ)
+            # no journal in the bench arm: the delta measures the
+            # scraper's page reads, not journal fsyncs
+            env.pop("BFTPU_TELEMETRY", None)
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "bluefog_tpu.monitor",
+                 "--job", job, "--daemon", "--interval", "0.1"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                env=env)
+        try:
+            return islands.spawn(
+                functools.partial(_lab_probe_worker, mb=mb, iters=iters,
+                                  warmup=warmup),
+                1, job=job, timeout=600.0)[0]
+        finally:
+            if proc is not None:
+                proc.terminate()
+                try:
+                    proc.wait(5.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+
+    t_off = t_on = None
+    for _ in range(repeats):
+        dt = one_dt(False)
+        t_off = dt if t_off is None else min(t_off, dt)
+        dt = one_dt(True)
+        t_on = dt if t_on is None else min(t_on, dt)
+    pct = (t_on - t_off) / t_off * 100.0 if t_off else 0.0
+    return {
+        "metric": f"island gossip fleet-monitor overhead (single process "
+                  f"self-edge, {mb:g} MB payload, scraper attached at "
+                  f"0.1 s, per-iter median, best of {repeats})",
+        "value": round(pct, 2),
+        "unit": "%",
+        "round_off_us": round(t_off * 1e6, 1),
+        "round_on_us": round(t_on * 1e6, 1),
+        "us_per_round": round((t_on - t_off) * 1e6, 1),
+        "contract_pct": 2.0,
+    }
+
+
 def _tcp_wire_worker(rank, size, mb, iters, warmup):
     """Gossip loop over the TCP mailbox, returning the wire accounting
     counters alongside the timing (the compression-ratio headline needs

@@ -74,6 +74,13 @@ consumers (CLI, pytest, CI):
   and pinned distribution campaigns (interior relay killed mid-fan-out,
   join storm mid-rollout) keep the tree-validity and staleness-SLO
   standing invariants silent while subtrees re-parent and converge;
+- **monitor** (:mod:`.monitor_rules`) — the fleet monitor's sim twin:
+  every seeded runtime-fault campaign raises exactly its matching
+  alert (mass leak, demotion-cap bypass, split brain, silent SLO
+  stall), the clean twins raise zero alerts with the campaign digest
+  bit-identical monitor-on vs monitor-off, and the alert engine's
+  gap-closing coalesces a sustained breach into one fully-accounted
+  window;
 - **slo** (:mod:`.slo_rules`) — the serve traffic observatory: pinned
   Poisson-load campaigns serve every admitted request within the SLO
   or excuse it with an overlapping fault window (replica kill,
@@ -136,6 +143,7 @@ from bluefog_tpu.analysis import (  # noqa: F401
     interleave,
     introspect_rules,
     lab_rules,
+    monitor_rules,
     partition_rules,
     plan_rules,
     progress_rules,

@@ -98,6 +98,8 @@ def main(argv=None) -> int:
             "serve": "~10 s (pinned serve campaigns + buffer model)",
             "slo": "~10 s (pinned traffic campaigns + latency "
                    "sampler pins)",
+            "monitor": "~15 s (monitored seeded-bug + clean-twin "
+                       "campaigns)",
             "distrib": "~15 s (pinned tree campaigns + exhaustive "
                        "kill/delta models)",
             "lab": "~5 s (frozen sweep artifact re-derivation)",
@@ -214,6 +216,28 @@ def main(argv=None) -> int:
             print(f"self-test FAILED: traffic campaign(s) failed "
                   f"{unattributed}")
             return 1
+        # monitor arm: the acceptance-size clean campaigns, monitored —
+        # zero alerts, digest and alert list bit-identical on replay
+        from bluefog_tpu.analysis import monitor_rules
+
+        alarmed = []
+        for label, res, findings in (
+                monitor_rules.selftest_monitor_campaigns()):
+            ok = not findings
+            mon = res.final.get("monitor") or {}
+            print(f"  {label:<36s} "
+                  f"{'clean' if ok else 'VIOLATED'} "
+                  f"(samples={mon.get('samples')}, "
+                  f"alerts={len(mon.get('alerts', ()))}, "
+                  f"digest={res.digest[:12]})")
+            for f in findings:
+                print(f"    {f}")
+            if not ok:
+                alarmed.append(label)
+        if alarmed:
+            print(f"self-test FAILED: monitored campaign(s) failed "
+                  f"{alarmed}")
+            return 1
         # distrib arm: acceptance-size distribution-tree campaigns
         # (relay kills + join storm mid-rollout at >= 64 ranks) must
         # re-parent cleanly, converge, and replay bit-identically
@@ -292,6 +316,7 @@ def main(argv=None) -> int:
               f"+ {len(partition_rules.PARTITION_PINS)} partition "
               f"+ {len(serve_rules.SERVE_PINS)} serve "
               f"+ {len(slo_rules.SLO_PINS)} traffic "
+              f"+ {len(monitor_rules.MONITOR_PINS)} monitored "
               f"+ {len(distrib_rules.DISTRIB_PINS)} distrib campaigns "
               f"clean, "
               f"lab artifact verified ({ncells} cells), transports "

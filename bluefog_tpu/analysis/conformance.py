@@ -824,6 +824,15 @@ FAMILY_MAP: Dict[str, Tuple[str, ...]] = {
     "bluefog_tpu/serve/distrib/feed.py": ("distrib", "wire"),
     "bluefog_tpu/serve/distrib/sub.py": ("distrib", "serve"),
     "bluefog_tpu/analysis/distrib_rules.py": ("distrib",),
+    # the fleet monitor: the alert engine and its sim twin are gated by
+    # the monitor family; the scraper and store additionally by
+    # introspect (they ride the statuspage seqlock protocol) and the
+    # report joiner by telemetry (it joins the journal schema)
+    "bluefog_tpu/monitor/rules.py": ("monitor",),
+    "bluefog_tpu/monitor/scraper.py": ("monitor", "introspect"),
+    "bluefog_tpu/monitor/store.py": ("monitor", "introspect"),
+    "bluefog_tpu/monitor/tail.py": ("monitor", "telemetry"),
+    "bluefog_tpu/monitor/report.py": ("monitor", "telemetry"),
 }
 
 

@@ -792,6 +792,47 @@ def _omission_biased_loadgen() -> List[Finding]:
         res, "fixture[omission-biased-loadgen]")
 
 
+def _monitor_silent_alert() -> List[Finding]:
+    """A monitored mass-leak campaign whose monitor scrapes but never
+    feeds its alert engine (``mon_silent``): the leak runs to quiesce
+    with no alert fired, and the alert-completeness audit must flag
+    the silence."""
+    from bluefog_tpu.analysis import monitor_rules
+
+    _cfg, _sched, res = monitor_rules.monitored_campaign(
+        16, 20, 3, debug_bugs=("mass_leak", "mon_silent"))
+    return monitor_rules.monitor_findings(
+        res, "fixture[monitor-silent-alert]",
+        expect=("mass_imbalance",))
+
+
+def _monitor_flapping_alert() -> List[Finding]:
+    """A monitored mass-leak campaign whose engine gap-close is set to
+    a hundredth of the sample cadence (``mon_flap``): one sustained
+    breach opens a fresh window at every sample, and the
+    window-coalescing audit must flag the flapping."""
+    from bluefog_tpu.analysis import monitor_rules
+
+    _cfg, _sched, res = monitor_rules.monitored_campaign(
+        16, 20, 3, debug_bugs=("mass_leak", "mon_flap"))
+    return monitor_rules.monitor_findings(
+        res, "fixture[monitor-flapping-alert]",
+        expect=("mass_imbalance",))
+
+
+def _monitor_false_alarm() -> List[Finding]:
+    """A CLEAN campaign watched by a naive fork detector that alarms
+    on ANY membership-view divergence (``mon_naive_fork``): the normal
+    kill/heal adoption transient raises a spurious ``epoch_fork``,
+    which the false-alarm-free audit must flag."""
+    from bluefog_tpu.analysis import monitor_rules
+
+    _cfg, _sched, res = monitor_rules.monitored_campaign(
+        16, 20, 3, debug_bugs=("mon_naive_fork",))
+    return monitor_rules.monitor_findings(
+        res, "fixture[monitor-false-alarm]", expect=())
+
+
 FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
     # plan family
     "plan-duplicate-destination": _plan_duplicate_destination,
@@ -876,6 +917,11 @@ FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
     # drain that re-anchors send times (coordinated omission)
     "slo-silent-violation": _slo_silent_violation,
     "omission-biased-loadgen": _omission_biased_loadgen,
+    # monitor family: a silent monitor, a flapping monitor, and a
+    # false-alarming fork detector
+    "monitor-silent-alert": _monitor_silent_alert,
+    "monitor-flapping-alert": _monitor_flapping_alert,
+    "monitor-false-alarm": _monitor_false_alarm,
     # distrib family: an uncapped tree repair, a stalled orphan
     # subtree, a regressing publisher handoff, a dirty chunk dropped
     # from a delta

@@ -162,6 +162,16 @@ def render(snap: dict, rates: Dict[int, float]) -> str:
                     if v.get("qps", -1.0) >= 0 else "")
                 for r, v in sorted(snap["serve"].items(),
                                    key=lambda kv: int(kv[0]))))
+    if snap.get("monitor"):
+        lines.append("")
+        # the fleet-monitor lamp (statuspage v8): quiet/FIRING plus the
+        # last alert's rule name — one glance answers "is it alarming?"
+        lines.append("monitor: " + ", ".join(
+            (f"r{r} FIRING [{m['last']}]" if m["state"] == 1 else
+             f"r{r} quiet" + (f" (last {m['last']})" if m["last"] else ""))
+            + f" scrapes {m['scrapes']} firings {m['firings']}"
+            for r, m in sorted(snap["monitor"].items(),
+                               key=lambda kv: int(kv[0]))))
     if snap.get("orphans"):
         lines.append("")
         lines.append(f"ORPHANED (quorum lost, quiesced): "

@@ -29,9 +29,9 @@ from typing import Dict, List, Optional, Tuple
 
 from bluefog_tpu.native import shm_native
 
-STATUS_SCHEMA = "bftpu-statuspage/7"
+STATUS_SCHEMA = "bftpu-statuspage/8"
 STATUS_MAGIC = 0x42465350  # "BFSP"
-STATUS_VERSION = 7
+STATUS_VERSION = 8
 
 #: Page layout: header (magic u32, version u32, seq u64), fixed block,
 #: then up to MAX_EDGES edge records; the whole page is padded to
@@ -53,8 +53,13 @@ STATUS_VERSION = 7
 #: replica's rolling request window, and slo_state: -1 = no SLO armed
 #: or no traffic yet, 0 = inside the BFTPU_SERVE_SLO_MS objective,
 #: 1 = currently violating — see docs/SERVING.md "Measuring serve
-#: latency under churn").  Readers still decode v1..v6 pages from live
-#: older writers.
+#: latency under churn"); v8 appends the fleet-monitor alert lamp
+#: (alert_state: -1 = no monitor attached / no samples yet, 0 =
+#: sampled and quiet, 1 = an alert window is open, plus the 16-byte
+#: last-alert rule name — written only by the monitor's own page at
+#: MONITOR_RANK, every worker page reads -1/"" — see
+#: docs/OBSERVABILITY.md "Fleet monitor").  Readers still decode
+#: v1..v7 pages from live older writers.
 _HEAD = struct.Struct("<IIQ")                 # magic, version, seq
 _FIXED_V1 = struct.Struct("<iiiiQQQdd16sdddd")  # rank, nranks, pid, n_edges,
 #                                                 step, epoch, op_id,
@@ -68,9 +73,11 @@ _FIXED_V5 = struct.Struct("<iiiiQQQdd16sddddi16sdqiqq")  # ... +
 #                                               serve_version, serve_lag
 _FIXED_V6 = struct.Struct("<iiiiQQQdd16sddddi16sdqiqqii")  # ... +
 #                                               distrib_slot, distrib_parent
-_FIXED = struct.Struct("<iiiiQQQdd16sddddi16sdqiqqiidddi")  # ... +
+_FIXED_V7 = struct.Struct("<iiiiQQQdd16sddddi16sdqiqqiidddi")  # ... +
 #                                               qps, p50_ms, p99_ms,
 #                                               slo_state
+_FIXED = struct.Struct("<iiiiQQQdd16sddddi16sdqiqqiidddii16s")  # ... +
+#                                               alert_state, last_alert
 _EDGE = struct.Struct("<iid")                 # peer_global, state, deadline_s
 MAX_EDGES = 32
 PAGE_BYTES = 1024
@@ -118,7 +125,8 @@ class StatusPage:
                 serve_lag: int = -1, distrib_slot: int = -1,
                 distrib_parent: int = -1, qps: float = -1.0,
                 p50_ms: float = -1.0, p99_ms: float = -1.0,
-                slo_state: int = -1) -> None:
+                slo_state: int = -1, alert_state: int = -1,
+                last_alert: str = "") -> None:
         """Seqlocked single-writer update of the whole page.
 
         ``edges`` is an iterable of ``(peer_global, state_code,
@@ -136,7 +144,10 @@ class StatusPage:
         ``qps``/``p50_ms``/``p99_ms``/``slo_state`` are the v7
         request-level serve telemetry (-1 = no request traffic
         observed; slo_state 0 = within the latency SLO, 1 =
-        violating)."""
+        violating); ``alert_state``/``last_alert`` are the v8 fleet-
+        monitor lamp (-1 = this page is not a monitor / no samples
+        yet; only the monitor daemon's page at MONITOR_RANK writes
+        them)."""
         mm = self._seg._mm
         led = ledger or {}
         ed = list(edges)[:MAX_EDGES]
@@ -157,7 +168,9 @@ class StatusPage:
             float(conv_err), int(conv_round), int(flags),
             int(serve_version), int(serve_lag),
             int(distrib_slot), int(distrib_parent),
-            float(qps), float(p50_ms), float(p99_ms), int(slo_state))
+            float(qps), float(p50_ms), float(p99_ms), int(slo_state),
+            int(alert_state),
+            str(last_alert).encode("utf-8", "replace")[:16])
         off = _HEAD.size + _FIXED.size
         for peer, state, deadline in ed:
             _EDGE.pack_into(mm, off, int(peer), int(state), float(deadline))
@@ -173,7 +186,7 @@ def _decode(buf: bytes) -> Dict[str, object]:
     magic, version, seq = _HEAD.unpack_from(buf, 0)
     if magic != STATUS_MAGIC:
         raise ValueError(f"not a status page (magic 0x{magic:08x})")
-    if version not in (1, 2, 3, 4, 5, 6, STATUS_VERSION):
+    if version not in (1, 2, 3, 4, 5, 6, 7, STATUS_VERSION):
         raise ValueError(f"unsupported status-page version {version}")
     if version == 1:
         # a live v1 writer (mid-upgrade fleet): no progress-engine block
@@ -186,6 +199,7 @@ def _decode(buf: bytes) -> Dict[str, object]:
         serve_version, serve_lag = -1, -1
         distrib_slot, distrib_parent = -1, -1
         qps, p50_ms, p99_ms, slo_state = -1.0, -1.0, -1.0, -1
+        alert_state, last_alert = -1, b""
         fixed_size = _FIXED_V1.size
     elif version == 2:
         # a live v2 writer: progress block, no convergence word
@@ -197,6 +211,7 @@ def _decode(buf: bytes) -> Dict[str, object]:
         serve_version, serve_lag = -1, -1
         distrib_slot, distrib_parent = -1, -1
         qps, p50_ms, p99_ms, slo_state = -1.0, -1.0, -1.0, -1
+        alert_state, last_alert = -1, b""
         fixed_size = _FIXED_V2.size
     elif version == 3:
         # a live v3 writer: convergence word, no flags word
@@ -207,6 +222,7 @@ def _decode(buf: bytes) -> Dict[str, object]:
         serve_version, serve_lag = -1, -1
         distrib_slot, distrib_parent = -1, -1
         qps, p50_ms, p99_ms, slo_state = -1.0, -1.0, -1.0, -1
+        alert_state, last_alert = -1, b""
         fixed_size = _FIXED_V3.size
     elif version == 4:
         # a live v4 writer: flags word, no serving plane
@@ -217,6 +233,7 @@ def _decode(buf: bytes) -> Dict[str, object]:
         serve_version, serve_lag = -1, -1
         distrib_slot, distrib_parent = -1, -1
         qps, p50_ms, p99_ms, slo_state = -1.0, -1.0, -1.0, -1
+        alert_state, last_alert = -1, b""
         fixed_size = _FIXED_V4.size
     elif version == 5:
         # a live v5 writer: serving plane, no distribution tree
@@ -227,6 +244,7 @@ def _decode(buf: bytes) -> Dict[str, object]:
             buf, _HEAD.size)
         distrib_slot, distrib_parent = -1, -1
         qps, p50_ms, p99_ms, slo_state = -1.0, -1.0, -1.0, -1
+        alert_state, last_alert = -1, b""
         fixed_size = _FIXED_V5.size
     elif version == 6:
         # a live v6 writer: distribution tree, no request telemetry
@@ -237,15 +255,27 @@ def _decode(buf: bytes) -> Dict[str, object]:
          distrib_slot, distrib_parent) = _FIXED_V6.unpack_from(
             buf, _HEAD.size)
         qps, p50_ms, p99_ms, slo_state = -1.0, -1.0, -1.0, -1
+        alert_state, last_alert = -1, b""
         fixed_size = _FIXED_V6.size
+    elif version == 7:
+        # a live v7 writer: request telemetry, no alert lamp
+        (rank, nranks, pid, n_edges, step, epoch, op_id, wall_ts, mono_ts,
+         last_op, dep, col, drn, pend, qdepth, inflight,
+         conv_err, conv_round, flags,
+         serve_version, serve_lag,
+         distrib_slot, distrib_parent,
+         qps, p50_ms, p99_ms, slo_state) = _FIXED_V7.unpack_from(
+            buf, _HEAD.size)
+        alert_state, last_alert = -1, b""
+        fixed_size = _FIXED_V7.size
     else:
         (rank, nranks, pid, n_edges, step, epoch, op_id, wall_ts, mono_ts,
          last_op, dep, col, drn, pend, qdepth, inflight,
          conv_err, conv_round, flags,
          serve_version, serve_lag,
          distrib_slot, distrib_parent,
-         qps, p50_ms, p99_ms, slo_state) = _FIXED.unpack_from(
-            buf, _HEAD.size)
+         qps, p50_ms, p99_ms, slo_state,
+         alert_state, last_alert) = _FIXED.unpack_from(buf, _HEAD.size)
         fixed_size = _FIXED.size
     edges: List[Dict[str, object]] = []
     off = _HEAD.size + fixed_size
@@ -315,6 +345,16 @@ def _decode(buf: bytes) -> Dict[str, object]:
         "distrib": {
             "slot": int(distrib_slot),
             "parent": int(distrib_parent),
+        },
+        # the fleet-monitor lamp (v8, docs/OBSERVABILITY.md "Fleet
+        # monitor"): only the monitor daemon's own page (MONITOR_RANK)
+        # writes it; state -1 = not a monitor page (or a pre-v8
+        # writer), 0 = sampled and quiet, 1 = an alert window is open,
+        # last = the most recent alert's rule name
+        "alert": {
+            "state": int(alert_state),
+            "last": last_alert.split(b"\0", 1)[0].decode(
+                "utf-8", "replace"),
         },
         "edges": edges,
     }
@@ -420,6 +460,19 @@ def collect(job: str) -> Dict[str, object]:
             ent["slot"] = int(d["slot"])
             ent["parent"] = int(d["parent"])
         serve[str(r)] = ent
+    # the fleet-monitor lamp (v8): a page with alert_state >= 0 IS a
+    # monitor page (worker pages always read -1); step counts scrapes
+    # and op_id counts rule firings on the monitor's own page
+    monitor = {}
+    for r, p in sorted(fleet.items()):
+        if "error" in p or p.get("alert", {}).get("state", -1) < 0:
+            continue
+        monitor[str(r)] = {
+            "state": int(p["alert"]["state"]),
+            "last": p["alert"]["last"],
+            "scrapes": int(p.get("step", 0)),
+            "firings": int(p.get("op_id", 0)),
+        }
     return {
         "schema": "bftpu-top/1",
         "job": job,
@@ -432,6 +485,7 @@ def collect(job: str) -> Dict[str, object]:
         "serve": serve,
         "serve_published": max(
             (int(v["version"]) for v in serve.values()), default=-1),
+        "monitor": monitor,
     }
 
 

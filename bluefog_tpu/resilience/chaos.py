@@ -132,6 +132,18 @@ _LOADGEN_KEYS = ("BFTPU_LOADGEN_RATE_HZ", "BFTPU_LOADGEN_SCHEDULE",
                  "BFTPU_LOADGEN_SEED", "BFTPU_LOADGEN_DURATION_S",
                  "BFTPU_SERVE_SLO_MS", "BFTPU_SERVE_SLO_STALENESS")
 
+# fleet-monitor knobs (bluefog_tpu/monitor): stale alert thresholds or
+# a stale rules override re-arm the previous test's alert policy in the
+# next monitor's engine, and a stale scrape cadence or gap changes its
+# window coalescing — schedule-grade state like the loadgen SLO keys
+_MON_KEYS = ("BFTPU_MONITOR", "BFTPU_MON_SCRAPE_S", "BFTPU_MON_GAP_S",
+             "BFTPU_MON_RULES", "BFTPU_MON_SLOTS", "BFTPU_MON_RING",
+             "BFTPU_MON_LINGER", "BFTPU_MON_MASS_TOL",
+             "BFTPU_MON_EPOCH_STALL_S", "BFTPU_MON_SUSPECT_RATE",
+             "BFTPU_MON_SERVE_MAX_LAG", "BFTPU_MON_DISTRIB_STALENESS",
+             "BFTPU_MON_CONV_DIVERGE", "BFTPU_MON_CONV_PLATEAU_S",
+             "BFTPU_CHAOS_MON_DROP_SCRAPE")
+
 # injectable clock (sim/clock.py seam) for the delay/straggler sleeps;
 # process-level signals (suspend_self) always use wall time — you
 # cannot virtualize a SIGSTOP
@@ -338,9 +350,10 @@ def clear_schedule() -> None:
     """Scrub EVERY chaos key from the calling process's environment —
     kill, join, and suspend schedules alike (a stale key would replay
     the fault in the next test's workers) — plus the sim-campaign,
-    lab, and serving-plane keys, which are schedules by another name."""
+    lab, serving-plane, and monitor keys, which are schedules by
+    another name."""
     for k in _ALL_KEYS + _SIM_KEYS + _LAB_KEYS + _SERVE_KEYS \
-            + _DISTRIB_KEYS + _LOADGEN_KEYS:
+            + _DISTRIB_KEYS + _LOADGEN_KEYS + _MON_KEYS:
         os.environ.pop(k, None)
 
 

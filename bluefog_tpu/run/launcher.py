@@ -78,6 +78,11 @@ def build_env(args, base_env=None) -> dict:
         # islands mode: per-rank convergence probe (lab/probe.py); plain
         # env spelling BFTPU_LAB_PROBE=1 is forwarded anyway
         env["BFTPU_LAB_PROBE"] = "1"
+    if getattr(args, "monitor", False):
+        # islands mode: spawn the passive fleet monitor next to the
+        # workers (monitor/scraper.py); plain env spelling
+        # BFTPU_MONITOR=1 is forwarded anyway
+        env["BFTPU_MONITOR"] = "1"
     # Multi-host bootstrap: forwarded to jax.distributed.initialize via env
     # (JAX reads these standard variables).
     if args.coordinator:
@@ -498,6 +503,16 @@ def attach_main(job: str, command) -> int:
         from bluefog_tpu.introspect.__main__ import main as top_main
 
         return top_main(["--job", job] + list(command[1:]))
+    if command[0] == "monitor":
+        # fleet monitor: scrape daemon / store export / attribution
+        # report, all over shm + journals — no control socket needed
+        from bluefog_tpu.monitor.__main__ import main as mon_main
+
+        rest = list(command[1:])
+        if not any(a in ("--daemon", "--export", "--serve", "--report")
+                   for a in rest):
+            rest = ["--daemon"] + rest
+        return mon_main(["--job", job] + rest)
     if command[0] == "trace":
         if len(command) < 2 or command[1] not in ("on", "off", "default"):
             print("bftpu-run: trace needs a mode: trace on|off|default",
@@ -541,7 +556,8 @@ def attach_main(job: str, command) -> int:
         req = {"cmd": "status"}
     else:
         print(f"bftpu-run: unknown control command {command[0]!r} "
-              "(expected: scale +K, status, top, trace on|off|default)",
+              "(expected: scale +K, status, top, monitor, "
+              "trace on|off|default)",
               file=sys.stderr)
         return 2
     path = control_sock_path(job)
@@ -667,6 +683,16 @@ def main(argv=None) -> int:
         "column (docs/OBSERVABILITY.md, 'Convergence observatory')",
     )
     parser.add_argument(
+        "--monitor",
+        action="store_true",
+        help="islands mode: spawn the passive fleet monitor "
+        "(BFTPU_MONITOR=1) — a scrape daemon polling every rank's "
+        "status page, retaining time series in an mmap'd store and "
+        "raising declarative alerts (docs/OBSERVABILITY.md, "
+        "'Fleet monitor'); attach later with "
+        "bftpu-run --attach JOB monitor",
+    )
+    parser.add_argument(
         "--serve-replicas",
         type=int,
         default=0,
@@ -729,6 +755,9 @@ def main(argv=None) -> int:
     if args.serve_replicas and not args.islands:
         parser.error("--serve-replicas requires --islands (the snapshot "
                      "region is published by an islands fleet)")
+    if args.monitor and not args.islands:
+        parser.error("--monitor requires --islands (the scraper polls "
+                     "the fleet's per-rank status pages)")
     if args.serve_remote and not args.serve_replicas:
         parser.error("--serve-remote requires --serve-replicas (it "
                      "selects how those replicas attach)")
@@ -943,6 +972,16 @@ def _run_islands(cmd, env, nranks: int, job, hosts, timeout: float,
                 rc["BFTPU_SERVE_REMOTE"] = serve_remote
                 serve_cmd += ["--remote", serve_remote]
             serve_procs.append(subprocess.Popen(serve_cmd, env=rc))
+        # fleet monitor: one passive scrape daemon per job.  It only
+        # reads seqlock'd pages and journals, exits on its own once the
+        # fleet's pages are reclaimed, and is SIGTERMed with the serve
+        # procs — a monitor dying never fails the training run.
+        if env.get("BFTPU_MONITOR", "0") not in ("", "0"):
+            mc = dict(env)
+            mc["BLUEFOG_ISLAND_JOB"] = job
+            serve_procs.append(subprocess.Popen(
+                [sys.executable, "-m", "bluefog_tpu.monitor",
+                 "--job", job, "--daemon"], env=mc))
         control = None
         try:
             if multi_host:

@@ -161,13 +161,29 @@ class SimConfig:
     # queueing delay silently exceeds the request SLO with no fault to
     # blame — the request-slo invariant fires), loadgen_omission (the
     # drain re-anchors each request's send time to "now", hiding the
-    # queueing delay — the open-loop invariant fires)
+    # queueing delay — the open-loop invariant fires), mon_silent (the
+    # monitor twin scrapes but never feeds its alert engine — the
+    # alert-completeness audit fires), mon_flap (the twin's gap-close
+    # is set below the sample cadence, so one sustained breach flaps a
+    # window per sample — the window-coalescing audit fires),
+    # mon_naive_fork (the fork detector alarms on ANY view divergence,
+    # so a clean heal transient raises a spurious epoch_fork — the
+    # false-alarm-free audit fires)
     debug_bugs: Tuple[str, ...] = ()
     # convergence observatory (bluefog_tpu.lab): record per-rank
     # successive-estimate differences each round.  The trace rides in
     # CampaignResult, NOT the event log — digests (and every existing
     # repro file) are unchanged whether it is on or off.
     trace_consensus: bool = False
+    # fleet-monitor twin (bluefog_tpu.monitor): run the SAME declarative
+    # alert engine the live scraper runs, against the virtual clock —
+    # sampling the fleet once per round_period.  Alert windows ride the
+    # final dict ("monitor"), NOT the event log, so digests (and every
+    # existing repro file) are unchanged whether it is on or off.  The
+    # monitor rule family holds two standing invariants over it: every
+    # seeded runtime-fault bug raises its matching alert, and the
+    # pinned clean campaigns raise zero, bit-identically.
+    monitor: bool = False
     # lockstep=True drops the per-rank start stagger so every round
     # fires at the same virtual instant; with deposit latency > 0 each
     # round then collects exactly the previous round's deposits — the

@@ -62,6 +62,7 @@ raised — the campaign layer decides whether to shrink.
 
 from __future__ import annotations
 
+import bisect
 import math
 import random
 from typing import Dict, List, Optional, Set, Tuple
@@ -142,6 +143,33 @@ class SimFleet:
         # and repro files are byte-identical with tracing on or off
         self.consensus_trace: List[tuple] = []
         self._conv_prev: Dict[int, float] = {}
+        # fleet-monitor twin (cfg.monitor): the live scraper's
+        # AlertEngine run against the VIRTUAL clock, sampled once per
+        # round_period.  Rules are built explicitly from cfg — never
+        # the BFTPU_MON_* env — so a monitored campaign replays
+        # bit-identically anywhere; alert windows ride the final dict
+        # ("monitor"), NOT the event_log, so digests and repro files
+        # are unchanged whether the twin is on or off.
+        self._monitor = None
+        self._mon_next = 0.0
+        self._mon_samples = 0
+        self._mon_demote_ex = 0.0
+        if getattr(cfg, "monitor", False):
+            from bluefog_tpu.monitor.rules import AlertEngine, AlertRule
+
+            self._monitor = AlertEngine(rules=(
+                AlertRule("mass_imbalance", "mass_err", "gt",
+                          float(cfg.mass_tol),
+                          "sim: conservation residual past cfg.mass_tol"),
+                AlertRule("epoch_fork", "epoch_fork", "nonzero", 0.0,
+                          "sim: two live member views of one epoch"),
+                AlertRule("demote_storm", "demote_excess", "gt", 0.0,
+                          "sim: committed demotions exceed the cap"),
+                AlertRule("request_slo", "request_slo", "nonzero", 0.0,
+                          "sim: admitted request overdue unserved"),
+            ), gap_s=(0.01 if "mon_flap" in cfg.debug_bugs else 2.5)
+                * float(cfg.round_period))
+            self._mon_next = float(_T0)
         self._epoch_word_seen = 0
         self._topo_cache: Dict[object, tuple] = {}
         # graphs already audited doubly stochastic (id -> graph ref)
@@ -1548,6 +1576,84 @@ class SimFleet:
                 self._violate("minority-demotion",
                               f"committed at {point}: {err}", g)
         self._check_arrivals(point, g)
+        if self._monitor is not None:
+            if commit_members is not None and commit_demoted is not None:
+                # demotion pressure is event-borne, not state-borne:
+                # remember the worst excess seen since the last sample
+                self._mon_demote_ex = max(
+                    self._mon_demote_ex,
+                    float(commit_demoted - _inv.demotion_cap(
+                        commit_members)))
+            while self.loop.now >= self._mon_next:
+                self._monitor_sample(self._mon_next)
+                self._mon_next += float(self.cfg.round_period)
+
+    def _monitor_sample(self, t: float) -> None:
+        """One virtual-clock scrape: derive the monitor series from the
+        fleet state and feed the SAME engine the live scraper runs.
+        Virtual time serves as both monotonic and wall twin."""
+        points: List[Tuple[str, str, float]] = []
+        # conservation residual over the same buckets the standing
+        # invariant sums (live + slots + inflight + lost vs initial +
+        # joined, relative to scale)
+        sx, sp = self.transport.slot_mass()
+        ix, ip = self.transport.inflight_mass()
+        live_x = math.fsum(r.x for r in self.ranks.values()
+                           if not r.killed and not r.exited)
+        live_p = math.fsum(r.p for r in self.ranks.values()
+                           if not r.killed and not r.exited)
+        want_x = self.initial_x + self.joined_x
+        want_p = self.initial_p + self.joined_p
+        dx = abs(live_x + sx + ix + self.transport.lost_x - want_x) \
+            / max(1.0, abs(want_x))
+        dp = abs(live_p + sp + ip + self.transport.lost_p - want_p) \
+            / max(1.0, abs(want_p))
+        points.append(("mass_err", "fleet", max(dx, dp)))
+        # split brain: two live, non-orphan groups at one epoch whose
+        # views MUTUALLY exclude each other's live holders.  Merely
+        # different views are a normal heal-adoption transient (the
+        # laggard's view is a superset of the adopter's); a fork means
+        # each side has healed the other side out while both still run.
+        by_epoch: Dict[int, Dict[tuple, List[int]]] = {}
+        for g, r in sorted(self.ranks.items()):
+            if r.killed or r.exited or r.orphaned:
+                continue
+            by_epoch.setdefault(r.epoch, {}).setdefault(
+                tuple(r.members), []).append(g)
+        fork = 0.0
+        for vs in by_epoch.values():
+            items = sorted(vs.items())
+            for a in range(len(items)):
+                for b in range(a + 1, len(items)):
+                    va, ha = items[a]
+                    vb, hb = items[b]
+                    if "mon_naive_fork" in self.cfg.debug_bugs:
+                        # seeded defect: a detector that alarms on ANY
+                        # view divergence — heal transients included
+                        fork = 1.0
+                    elif (any(g not in va for g in hb)
+                            and any(g not in vb for g in ha)):
+                        fork = 1.0
+        points.append(("epoch_fork", "fleet", fork))
+        points.append(("demote_excess", "fleet", self._mon_demote_ex))
+        self._mon_demote_ex = 0.0
+        # overdue admitted-but-unserved requests per replica model
+        if self._arrivals:
+            for i, rep in sorted(self._serve_replicas.items()):
+                arr = rep.get("arr")
+                if not arr:
+                    continue
+                k = bisect.bisect_right(arr, t)
+                overdue = any(t - arr[j] > self._req_slo
+                              for j in range(rep["arr_i"], k))
+                points.append(("request_slo", f"replica{i}",
+                               1.0 if overdue else 0.0))
+        self._mon_samples += 1
+        if "mon_silent" in self.cfg.debug_bugs:
+            # seeded defect: a monitor that scrapes but never feeds its
+            # engine — every alert goes silent
+            points = []
+        self._monitor.feed(t, points, wall=t)
 
     def run(self) -> None:
         self.loop.run(max_events=self.cfg.max_events)
@@ -1671,6 +1777,19 @@ class SimFleet:
                     "attributed": self._req_attributed,
                     "windows": len(self._arr_windows),
                 }
+        if self._monitor is not None:
+            # catch up the sample grid to the quiesce instant, then
+            # flush every still-open window — an alert that never got
+            # its quiet gap is an alert, not a lost record
+            while self._mon_next <= self.loop.now:
+                self._monitor_sample(self._mon_next)
+                self._mon_next += float(self.cfg.round_period)
+            self._monitor.close()
+            out["monitor"] = {
+                "samples": self._mon_samples,
+                "firings": self._monitor.firings,
+                "alerts": [dict(w) for w in self._monitor.windows],
+            }
         return out
 
     def _members_now(self) -> Set[int]:

@@ -96,7 +96,8 @@ def test_status_page_rejects_foreign_layout(shm_dir):
 # every historical fixed-block layout, oldest first — a mid-upgrade
 # fleet has live writers at any of these versions at once
 _V_STRUCTS = {1: sp._FIXED_V1, 2: sp._FIXED_V2, 3: sp._FIXED_V3,
-              4: sp._FIXED_V4, 5: sp._FIXED_V5, 6: sp._FIXED_V6}
+              4: sp._FIXED_V4, 5: sp._FIXED_V5, 6: sp._FIXED_V6,
+              7: sp._FIXED_V7}
 
 
 def _pack_legacy_page(version, seg, rank=0):
@@ -112,15 +113,18 @@ def _pack_legacy_page(version, seg, rank=0):
         fields += [11, 2]              # serve_version, serve_lag
     if version >= 6:
         fields += [1, 0]               # distrib_slot, distrib_parent
+    if version >= 7:
+        fields += [120.0, 1.5, 4.0, 0]  # qps, p50_ms, p99_ms, slo_state
     sp._HEAD.pack_into(seg._mm, 0, sp.STATUS_MAGIC, version, 2)
     _V_STRUCTS[version].pack_into(seg._mm, sp._HEAD.size, *fields)
 
 
 @pytest.mark.parametrize("version", sorted(_V_STRUCTS))
 def test_status_page_back_compat_every_version_decodes(shm_dir, version):
-    """v1..v6 pages (live writers in a mid-upgrade fleet) decode with
-    the fields their layout lacks defaulted — in particular the v7
-    request-telemetry block reads as "no traffic observed"."""
+    """v1..v7 pages (live writers in a mid-upgrade fleet) decode with
+    the fields their layout lacks defaulted — the v7 request-telemetry
+    block reads as "no traffic observed" on pre-v7 pages and the v8
+    alert lamp reads as "no monitor attached" on every legacy page."""
     path = sp.status_page_path("compat", version)
     seg = shm_native._FallbackSegment(path, sp.PAGE_BYTES)
     try:
@@ -129,10 +133,17 @@ def test_status_page_back_compat_every_version_decodes(shm_dir, version):
         assert got["version"] == version
         assert (got["step"], got["epoch"], got["op_id"]) == (9, 1, 5)
         assert got["ledger"]["balance"] == pytest.approx(4.0 - 2.0 - 1.0)
-        assert got["serve"]["qps"] == -1.0
-        assert got["serve"]["p50_ms"] == -1.0
-        assert got["serve"]["p99_ms"] == -1.0
-        assert got["serve"]["slo_state"] == -1
+        if version >= 7:
+            assert got["serve"]["qps"] == pytest.approx(120.0)
+            assert got["serve"]["p50_ms"] == pytest.approx(1.5)
+            assert got["serve"]["p99_ms"] == pytest.approx(4.0)
+            assert got["serve"]["slo_state"] == 0
+        else:
+            assert got["serve"]["qps"] == -1.0
+            assert got["serve"]["p50_ms"] == -1.0
+            assert got["serve"]["p99_ms"] == -1.0
+            assert got["serve"]["slo_state"] == -1
+        assert got["alert"] == {"state": -1, "last": ""}
         if version >= 5:
             assert (got["serve"]["version"], got["serve"]["lag"]) == (11, 2)
         else:
